@@ -1,0 +1,274 @@
+"""Batched prime-field arithmetic for TPU in 13-bit x 20 int32 limbs.
+
+This is the arithmetic substrate for every device curve kernel (ed25519,
+sr25519/ristretto, secp256k1). Design constraints, in order:
+
+* **int32 only.** TPUs have no native 64-bit integer multiply; XLA emulates
+  int64 with multi-instruction sequences. Limb radix 2^13 makes a full
+  schoolbook product column fit int32: 20 * (2^13 + eps)^2 ~= 1.35e9 < 2^31.
+* **Vectorized carries.** Carry propagation is done in parallel passes over
+  all limbs (shift / mask / shifted-add) instead of sequential ripples; the
+  invariant "every limb |l| <= 2^13 + 2^4" (mul-safe) is restored after each
+  op. Full sequential ripple happens only inside `canonical` (equality /
+  parity checks, ~3x per signature verify).
+* **Signed lazy limbs.** Limbs are signed; arithmetic right shift gives
+  floor semantics so the same carry code handles negative intermediates
+  (subtraction needs no bias constant).
+* **Generic modulus.** Reduction works for any prime 2^248 <= p < 2^257 via
+  fold constants derived from powers of two mod p; ed25519's p = 2^255-19
+  and secp256k1's p = 2^256-2^32-977 both instantiate it.
+
+Shapes: a field-element batch is an int32 array `(..., NLIMBS)`.
+
+The reference this replaces is the external Go asm crypto cores
+(oasisprotocol/curve25519-voi, btcsuite/btcec — SURVEY.md §2.1); CometBFT
+itself has no field arithmetic to cite, it delegates to those dependencies.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 13
+NLIMBS = 20
+MASK = (1 << LIMB_BITS) - 1
+TOTAL_BITS = LIMB_BITS * NLIMBS  # 260
+
+
+def _int_to_limbs(v: int, n: int = NLIMBS, fat_top: bool = False) -> np.ndarray:
+    """Decompose a nonnegative int into n 13-bit limbs (numpy int32).
+
+    With fat_top, all bits >= 13*(n-1) go into the top limb (used for
+    constants slightly wider than 13*n bits, e.g. 64p)."""
+    limbs = []
+    for i in range(n):
+        if i == n - 1 and fat_top:
+            limbs.append(v >> (LIMB_BITS * i))
+        else:
+            limbs.append((v >> (LIMB_BITS * i)) & MASK)
+    out = np.array(limbs, dtype=np.int64)
+    assert (out < 2**31).all() and (out >= 0).all()
+    assert not fat_top or sum(
+        int(x) << (LIMB_BITS * i) for i, x in enumerate(out)
+    ) == v
+    return out.astype(np.int32)
+
+
+def limbs_to_int(limbs):
+    """Host-side: recompose (possibly signed/wide) limbs into Python ints.
+
+    Returns a Python int for a 1-D input, an object ndarray otherwise.
+    """
+    arr = np.asarray(limbs)
+    obj = arr.astype(object)
+    out = 0
+    for i in range(arr.shape[-1]):
+        out = out + (obj[..., i] << (LIMB_BITS * i))
+    return out
+
+
+def _shift_up(c, width=None):
+    """Move per-limb carries one limb up (drop nothing; pad at bottom)."""
+    pad = [(0, 0)] * (c.ndim - 1) + [(1, 0)]
+    return jnp.pad(c[..., :-1] if width is None else c, pad)[
+        ..., : (c.shape[-1] if width is None else width)
+    ]
+
+
+class Field:
+    """A prime field instance with precomputed reduction constants.
+
+    All `jnp` methods are shape-polymorphic over leading batch dims and
+    traceable under jit/scan/shard_map.
+    """
+
+    def __init__(self, p: int):
+        assert 2**248 <= p < 2**257
+        self.p = p
+        # fold constant for weight 2^260 (one limb past the top of the grid)
+        self.fold260 = _int_to_limbs((1 << TOTAL_BITS) % p)
+        self.fold_pairs = [
+            (i, int(l)) for i, l in enumerate(self.fold260) if l != 0
+        ]
+        self.max_off = max(i for i, _ in self.fold_pairs)
+        assert self.max_off <= 4, "fold tail too long for this modulus"
+        # canonicalization constants
+        self.shift = p.bit_length()  # 255 or 256; sits inside limb 19
+        assert LIMB_BITS * (NLIMBS - 1) < self.shift <= TOTAL_BITS
+        self.fold_top = _int_to_limbs((1 << self.shift) % p)
+        self.bias64p = _int_to_limbs(64 * p, fat_top=True)  # value >= 2^261
+        self.p_limbs = _int_to_limbs(p)
+
+    # -- host-side conversions (numpy) ---------------------------------------
+
+    def from_int(self, v: int) -> np.ndarray:
+        return _int_to_limbs(v % self.p)
+
+    def from_bytes_le(self, b: np.ndarray, nbits: int = 256) -> np.ndarray:
+        """(..., 32) uint8 little-endian -> (..., NLIMBS) int32 limbs.
+
+        Keeps only the low `nbits` bits. Does NOT reduce mod p.
+        """
+        b = np.ascontiguousarray(b, dtype=np.uint8)
+        bits = np.unpackbits(b, axis=-1, bitorder="little")[..., :nbits]
+        pad = TOTAL_BITS - nbits
+        bits = np.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+        bits = bits.reshape(bits.shape[:-1] + (NLIMBS, LIMB_BITS))
+        weights = 1 << np.arange(LIMB_BITS, dtype=np.int32)
+        return (bits.astype(np.int32) * weights).sum(-1).astype(np.int32)
+
+    # -- device ops (jnp, traceable) -----------------------------------------
+
+    def carry(self, x):
+        """Two parallel carry passes with a top fold through 2^260 mod p.
+
+        Contract: restores the mul-safe invariant (|limb| <= 2^13 + 2^4)
+        ONLY for |input limb| <= 2^14 + 2^5 (i.e. post-add/sub values).
+        For wider inputs (|limb| < 2^26) the result is bounded by ~2^14 and
+        a second carry() is REQUIRED before the value may enter mul() —
+        see mul_small and reduce_wide, which do exactly that.
+        """
+        c = x >> LIMB_BITS
+        x = x - (c << LIMB_BITS)
+        x = x + _shift_up(c)
+        x = x + c[..., -1:] * jnp.asarray(self.fold260, x.dtype)
+        c = x >> LIMB_BITS
+        c = c.at[..., -1].set(0)  # keep the (tiny) top residual in place
+        x = x - (c << LIMB_BITS)
+        return x + _shift_up(c)
+
+    def add(self, a, b):
+        return self.carry(a + b)
+
+    def sub(self, a, b):
+        return self.carry(a - b)
+
+    def neg(self, a):
+        return -a
+
+    def mul_small(self, a, k: int):
+        """Multiply by a small host constant (|k| < 2^17)."""
+        assert 0 < abs(k) < 2**17
+        x = a * jnp.int32(k)  # |limb| <= 2^17 * 2^13.01 < 2^31
+        return self.carry(self.carry(x))
+
+    def mul(self, a, b):
+        """Field multiply; mul-safe limbs in, mul-safe limbs out."""
+        wide = 2 * NLIMBS - 1
+        acc = jnp.zeros(a.shape[:-1] + (wide,), dtype=jnp.int32)
+        for i in range(NLIMBS):
+            acc = acc.at[..., i : i + NLIMBS].add(a[..., i : i + 1] * b)
+        return self.reduce_wide(acc)
+
+    def square(self, a):
+        return self.mul(a, a)
+
+    def _pcarry_wide(self, x):
+        """One parallel carry pass on a wide column vector; width grows by 1
+        to keep the top carry-out."""
+        c = x >> LIMB_BITS
+        x = x - (c << LIMB_BITS)
+        nd = x.ndim
+        x = jnp.pad(x, [(0, 0)] * (nd - 1) + [(0, 1)])
+        return x + _shift_up(jnp.pad(c, [(0, 0)] * (nd - 1) + [(0, 1)]))
+
+    def reduce_wide(self, acc):
+        """Reduce >=20 columns of |col| < 2^31 to 20 mul-safe limbs.
+
+        Loop invariant bookkeeping (bounds checked in tests with adversarial
+        inputs): each iteration carries columns down to ~2^13 then folds the
+        high columns through 2^260 mod p; the high block shrinks by ~14
+        columns per iteration, so the Python loop terminates at trace time.
+        """
+        guard = 0
+        while acc.shape[-1] > NLIMBS:
+            guard += 1
+            assert guard < 8
+            acc = self._pcarry_wide(acc)  # cols <= 2^13 + 2^18
+            acc = self._pcarry_wide(acc)  # cols <= 2^13 + 2^5
+            high = acc[..., NLIMBS:]
+            low = acc[..., :NLIMBS]
+            nh = high.shape[-1]
+            w = max(NLIMBS, self.max_off + nh)
+            nd = low.ndim
+            buf = jnp.pad(low, [(0, 0)] * (nd - 1) + [(0, w - NLIMBS)])
+            for off, m in self.fold_pairs:
+                buf = buf.at[..., off : off + nh].add(high * jnp.int32(m))
+            acc = buf
+        return self.carry(self.carry(acc))
+
+    def pow_const(self, x, e: int):
+        """x ** e for a host-constant exponent, via lax.scan over e's bits."""
+        assert e > 0
+        bits = jnp.asarray(
+            [(e >> i) & 1 for i in reversed(range(e.bit_length()))],
+            dtype=jnp.int32,
+        )
+        one = self.const(1, x.shape[:-1])
+
+        def body(acc, bit):
+            acc = self.square(acc)
+            acc = jnp.where(bit != 0, self.mul(acc, x), acc)
+            return acc, None
+
+        acc, _ = jax.lax.scan(body, one, bits)
+        return acc
+
+    def inv(self, x):
+        return self.pow_const(x, self.p - 2)
+
+    def canonical(self, x):
+        """Fully reduce to the canonical representative in [0, p).
+
+        Sequential ripple carries — used only at equality/parity checks.
+        Input: any mul-safe limbs (value magnitude < 2^261).
+        """
+        x = x + jnp.asarray(self.bias64p, x.dtype)  # value now in (0, 2^263)
+        sh = self.shift - LIMB_BITS * (NLIMBS - 1)
+        for _ in range(2):
+            x = self._ripple(x)
+            hi = x[..., -1:] >> sh  # bits >= 2^shift, <= 2^16
+            x = x.at[..., -1].add(-(hi[..., 0] << sh))
+            x = x + hi * jnp.asarray(self.fold_top, x.dtype)
+        x = self._ripple(x)
+        # 0 <= value < 2p: conditionally subtract p once
+        t = self._ripple(x - jnp.asarray(self.p_limbs, x.dtype))
+        neg = t[..., -1] < 0
+        return jnp.where(neg[..., None], x, t)
+
+    def _ripple(self, x):
+        """Sequential signed carry; the top limb keeps any overflow (and the
+        sign of the whole value, since lower limbs end in [0, 2^13))."""
+        outs = []
+        c = jnp.zeros_like(x[..., 0])
+        for i in range(NLIMBS):
+            v = x[..., i] + c
+            if i < NLIMBS - 1:
+                c = v >> LIMB_BITS
+                v = v - (c << LIMB_BITS)
+            outs.append(v)
+        return jnp.stack(outs, axis=-1)
+
+    def is_zero(self, x):
+        return jnp.all(self.canonical(x) == 0, axis=-1)
+
+    def eq(self, a, b):
+        return self.is_zero(a - b)
+
+    def parity(self, x):
+        """LSB of the canonical representative (sign bit for compression)."""
+        return self.canonical(x)[..., 0] & 1
+
+    def select(self, cond, a, b):
+        """cond (...,) bool -> limbwise select(cond, a, b)."""
+        return jnp.where(cond[..., None], a, b)
+
+    def const(self, v: int, shape=()):
+        base = jnp.asarray(self.from_int(v))
+        return jnp.broadcast_to(base, tuple(shape) + (NLIMBS,))
+
+
+# The two base fields the framework ships curves for.
+F25519 = Field(2**255 - 19)
+FSECP = Field(2**256 - 2**32 - 977)

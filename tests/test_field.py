@@ -1,0 +1,103 @@
+"""Differential tests: JAX limb field arithmetic vs Python big ints."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cometbft_tpu.ops.field import F25519, FSECP, NLIMBS, limbs_to_int
+
+RNG = np.random.default_rng(7)
+FIELDS = [F25519, FSECP]
+
+
+def rand_elems(f, n):
+    vals = [int.from_bytes(RNG.bytes(40), "little") % f.p for _ in range(n)]
+    limbs = np.stack([f.from_int(v) for v in vals])
+    return vals, jnp.asarray(limbs)
+
+
+def check(f, got_limbs, expect_ints):
+    got = limbs_to_int(np.asarray(got_limbs))
+    got = np.asarray(got % f.p if isinstance(got, int) else [g % f.p for g in got])
+    exp = np.asarray([e % f.p for e in expect_ints])
+    np.testing.assert_array_equal(got, exp)
+
+
+@pytest.mark.parametrize("f", FIELDS, ids=["ed25519", "secp256k1"])
+def test_add_sub_mul(f):
+    a_int, a = rand_elems(f, 32)
+    b_int, b = rand_elems(f, 32)
+    check(f, f.add(a, b), [x + y for x, y in zip(a_int, b_int)])
+    check(f, f.sub(a, b), [x - y for x, y in zip(a_int, b_int)])
+    check(f, f.mul(a, b), [x * y for x, y in zip(a_int, b_int)])
+    check(f, f.square(a), [x * x for x in a_int])
+    check(f, f.neg(a), [-x for x in a_int])
+    check(f, f.mul_small(a, 121666), [x * 121666 for x in a_int])
+
+
+@pytest.mark.parametrize("f", FIELDS, ids=["ed25519", "secp256k1"])
+def test_deep_chain_no_canonical(f):
+    """Stress the lazy-limb invariant: long op chains w/o canonicalization."""
+    a_int, a = rand_elems(f, 8)
+    b_int, b = rand_elems(f, 8)
+    x, xi = a, list(a_int)
+    for i in range(50):
+        if i % 3 == 0:
+            x, xi = f.mul(x, b), [u * v for u, v in zip(xi, b_int)]
+        elif i % 3 == 1:
+            x, xi = f.sub(f.add(x, x), b), [2 * u - v for u, v in zip(xi, b_int)]
+        else:
+            x, xi = f.square(x), [u * u for u in xi]
+        xi = [u % f.p for u in xi]
+    check(f, x, xi)
+    # limbs stayed mul-safe throughout
+    assert int(np.abs(np.asarray(x)).max()) <= 2**13 + 2**6
+
+
+@pytest.mark.parametrize("f", FIELDS, ids=["ed25519", "secp256k1"])
+def test_edge_values(f):
+    vals = [0, 1, 2, f.p - 1, f.p - 2, (f.p - 1) // 2, 19, 2**255 - 20]
+    vals = [v % f.p for v in vals]
+    limbs = jnp.asarray(np.stack([f.from_int(v) for v in vals]))
+    check(f, f.mul(limbs, limbs), [v * v for v in vals])
+    check(f, f.sub(limbs, f.add(limbs, limbs)), [-v for v in vals])
+    z = f.sub(limbs, limbs)
+    assert bool(np.all(np.asarray(f.is_zero(z))))
+    # v + 1 is zero mod p exactly when v == p - 1
+    zp = np.asarray(f.is_zero(f.add(limbs, f.const(1, (len(vals),)))))
+    np.testing.assert_array_equal(zp, np.asarray([v == f.p - 1 for v in vals]))
+
+
+@pytest.mark.parametrize("f", FIELDS, ids=["ed25519", "secp256k1"])
+def test_pow_inv_canonical_parity(f):
+    a_int, a = rand_elems(f, 4)
+    check(f, f.pow_const(a, 5), [pow(v, 5, f.p) for v in a_int])
+    check(f, f.inv(a), [pow(v, f.p - 2, f.p) for v in a_int])
+    canon = np.asarray(f.canonical(f.mul(a, a)))
+    assert (canon >= 0).all() and (canon < 2**13).all()
+    got = limbs_to_int(canon)
+    np.testing.assert_array_equal(
+        np.asarray([int(g) for g in got]),
+        np.asarray([v * v % f.p for v in a_int]),
+    )
+    par = np.asarray(f.parity(a))
+    np.testing.assert_array_equal(par, np.asarray([v & 1 for v in a_int]))
+    assert bool(np.all(np.asarray(f.eq(a, a))))
+
+
+def test_from_bytes_le():
+    raw = RNG.integers(0, 256, size=(16, 32), dtype=np.uint8)
+    limbs = F25519.from_bytes_le(raw, nbits=255)
+    ints = limbs_to_int(limbs)
+    for i in range(16):
+        expect = int.from_bytes(raw[i].tobytes(), "little") & ((1 << 255) - 1)
+        assert int(ints[i]) == expect
+
+
+def test_eq_across_representations():
+    """Same value reached via different op chains must compare equal."""
+    f = F25519
+    a_int, a = rand_elems(f, 8)
+    x = f.mul(a, f.const(3, (8,)))
+    y = f.add(f.add(a, a), a)
+    assert bool(np.all(np.asarray(f.eq(x, y))))
