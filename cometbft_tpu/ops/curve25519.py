@@ -1,0 +1,215 @@
+"""Batched edwards25519 point arithmetic on the 13-bit-limb JAX field.
+
+Points are int32 arrays of shape (..., 4, NLIMBS) holding extended twisted
+Edwards coordinates (X : Y : Z : T) with x = X/Z, y = Y/Z, T = XY/Z on
+-x^2 + y^2 = 1 + d x^2 y^2. The unified a=-1 addition formulas are complete
+(no exceptional cases for identity/doubling inputs), which is what makes the
+batch kernel branch-free.
+
+Shared by the ed25519 verifier and (via ristretto255) the sr25519 verifier.
+Replaces the curve arithmetic CometBFT imports from curve25519-voi
+(SURVEY.md §2.1); there is no in-repo reference file for it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops.field import F25519, NLIMBS
+
+F = F25519
+D2 = (2 * ref.D) % ref.P  # 2d constant for the addition formula
+
+
+# Constants are kept as NUMPY arrays: jnp.asarray inside a jit trace
+# yields a tracer, and caching a tracer across traces is a leak. numpy
+# constants are safe to close over in any trace.
+_D2 = F.from_int(D2)
+_SQRT_M1 = F.from_int(ref.SQRT_M1)
+_D = F.from_int(ref.D)
+
+
+def identity(shape=()):
+    """The identity point (0, 1, 1, 0), broadcast over leading dims."""
+    one = F.const(1, shape)
+    zero = jnp.zeros_like(one)
+    return jnp.stack([zero, one, one, zero], axis=-2)
+
+
+def identity_like(batch_ref):
+    """Identity points (B, 4, NLIMBS) whose mesh-varying type is inherited
+    from batch_ref (any (B, ...) int array). Under shard_map a fresh
+    constant is 'unvarying' and cannot seed a scan/fori carry that mixes
+    with sharded data, so we derive a varying zero from real input."""
+    B = batch_ref.shape[0]
+    vzero = (batch_ref.reshape(B, -1)[:, :1] * 0).astype(jnp.int32)[..., None]
+    return identity((B,)) + vzero
+
+
+def from_affine_int(x: int, y: int) -> np.ndarray:
+    """Host: build a (4, NLIMBS) point from affine Python ints."""
+    return np.stack(
+        [
+            F.from_int(x),
+            F.from_int(y),
+            F.from_int(1),
+            F.from_int(x * y % ref.P),
+        ]
+    )
+
+
+def unstack(p):
+    return p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+
+
+def stack(x, y, z, t):
+    return jnp.stack([x, y, z, t], axis=-2)
+
+
+def add(p, q):
+    """Unified extended addition, add-2008-hwcd-3 for a = -1 (9 mul)."""
+    X1, Y1, Z1, T1 = unstack(p)
+    X2, Y2, Z2, T2 = unstack(q)
+    A = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    B = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    C = F.mul(F.mul(T1, T2), jnp.asarray(_D2))
+    Dv = F.mul_small(F.mul(Z1, Z2), 2)
+    E = F.sub(B, A)
+    Fv = F.sub(Dv, C)
+    G = F.add(Dv, C)
+    H = F.add(B, A)
+    return stack(F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
+
+
+def double(p):
+    """Extended doubling, dbl-2008-hwcd (4 mul + 4 sq)."""
+    X1, Y1, Z1, _ = unstack(p)
+    A = F.square(X1)
+    B = F.square(Y1)
+    C = F.mul_small(F.square(Z1), 2)
+    H = F.add(A, B)
+    E = F.sub(H, F.square(F.add(X1, Y1)))
+    G = F.sub(A, B)
+    Fv = F.add(C, G)
+    return stack(F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
+
+
+def neg(p):
+    X, Y, Z, T = unstack(p)
+    return stack(F.neg(X), Y, Z, F.neg(T))
+
+
+def select(cond, p, q):
+    """cond (...,) bool -> pointwise select(cond, p, q)."""
+    return jnp.where(cond[..., None, None], p, q)
+
+
+def is_identity(p):
+    """Projective identity check: X == 0 and Y == Z (mod p)."""
+    X, Y, Z, _ = unstack(p)
+    return F.is_zero(X) & F.eq(Y, Z)
+
+
+def decompress(y_limbs, sign_bits):
+    """Batched ZIP-215 point decompression.
+
+    y_limbs: (..., NLIMBS) the low 255 bits of the encoding (NOT reduced —
+    ZIP-215 accepts y >= p, we reduce here); sign_bits: (...,) int32 bit 255.
+    Returns (point, ok). On ok=False the point contents are garbage and the
+    caller must mask. Mirrors ed25519_ref.pt_decompress (zip215=True).
+    """
+    y = y_limbs  # mul/canonical reduce mod p implicitly
+    yy = F.square(y)
+    u = F.sub(yy, F.const(1, yy.shape[:-1]))
+    v = F.add(F.mul(yy, jnp.asarray(_D)), F.const(1, yy.shape[:-1]))
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    r = F.mul(F.mul(u, v3), F.pow_const(F.mul(u, v7), (ref.P - 5) // 8))
+    check = F.mul(v, F.square(r))
+    is_pos = F.eq(check, u)
+    is_neg = F.is_zero(F.add(check, u))  # check == -u
+    ok = is_pos | is_neg
+    x = F.select(is_neg, F.mul(r, jnp.asarray(_SQRT_M1)), r)
+    # fix sign: flip when parity differs from the sign bit. For x == 0 the
+    # flip yields -0 == 0, which is exactly ZIP-215's accept-(-0) rule.
+    flip = F.parity(x) != sign_bits
+    x = F.select(flip, F.neg(x), x)
+    point = stack(x, y, F.const(1, yy.shape[:-1]), F.mul(x, y))
+    return point, ok
+
+
+def scalar_mul_windowed(digits, p):
+    """[k]P for per-element points, k given as 64 base-16 digits.
+
+    digits: (B, 64) int32 in [0, 16), little-endian (digit w has weight
+    16^w); p: (B, 4, NLIMBS). Builds the 16-entry table with a scan, then
+    runs 63 iterations of 4 doublings + 1 table add (Horner over windows).
+    """
+
+    def table_step(prev, _):
+        nxt = add(prev, p)
+        return nxt, nxt
+
+    ident = identity_like(digits)
+    _, tbl = jax.lax.scan(table_step, ident, None, length=15)
+    table = jnp.concatenate([ident[None], tbl], axis=0)  # (16,B,4,n)
+    table = jnp.moveaxis(table, 0, 1)  # (B, 16, 4, n)
+
+    digits_t = digits.T  # (64, B)
+
+    def lookup(d):
+        return jnp.take_along_axis(
+            table, d[:, None, None, None], axis=1
+        ).squeeze(1)
+
+    def body(i, acc):
+        w = 62 - i
+        d = jax.lax.dynamic_index_in_dim(digits_t, w, 0, keepdims=False)
+        acc = double(double(double(double(acc))))
+        return add(acc, lookup(d))
+
+    acc0 = lookup(digits_t[63])
+    return jax.lax.fori_loop(0, 63, body, acc0)
+
+
+_BASE_TABLE = None
+
+
+def base_table() -> jnp.ndarray:
+    """(64, 16, 4, NLIMBS) comb table: entry [w][d] = [d * 16^w]B."""
+    global _BASE_TABLE
+    if _BASE_TABLE is None:
+        rows = []
+        for w in range(64):
+            step = pow(16, w, ref.L)
+            row = []
+            for d in range(16):
+                pt = ref.pt_mul(d * step, ref.BASE_EXT)
+                zi = pow(pt[2], ref.P - 2, ref.P)
+                x, y = pt[0] * zi % ref.P, pt[1] * zi % ref.P
+                row.append(from_affine_int(x, y))
+            rows.append(np.stack(row))
+        _BASE_TABLE = np.stack(rows)  # numpy: safe to close over in traces
+    return jnp.asarray(_BASE_TABLE)
+
+
+def base_scalar_mul(digits):
+    """[k]B for the fixed base point; k as (B, 64) base-16 digits.
+
+    Comb method: 64 table adds, no doublings.
+    """
+    bt = base_table()
+    digits_t = digits.T  # (64, B)
+
+    def body(i, acc):
+        row = jax.lax.dynamic_index_in_dim(bt, i, 0, keepdims=False)
+        entry = jnp.take(row, digits_t[i], axis=0)  # (B, 4, n)
+        return add(acc, entry)
+
+    return jax.lax.fori_loop(0, 64, body, identity_like(digits))
+
+
+def mul_by_cofactor(p):
+    return double(double(double(p)))

@@ -205,7 +205,10 @@ class Field:
             [(e >> i) & 1 for i in reversed(range(e.bit_length()))],
             dtype=jnp.int32,
         )
-        one = self.const(1, x.shape[:-1])
+        # seed the carry from x so it inherits x's mesh-varying type under
+        # shard_map (a fresh constant would be 'unvarying' and fail scan's
+        # carry type check)
+        one = (x * 0).at[..., 0].set(1)
 
         def body(acc, bit):
             acc = self.square(acc)

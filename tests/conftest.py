@@ -1,14 +1,24 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Tests never touch the real TPU chip; multi-chip sharding is validated on a
-virtual CPU mesh per the driver contract (see __graft_entry__.dryrun_multichip).
-This must run before any test module imports jax.
+Tests must not depend on — or hog — the single real TPU chip; multi-chip
+sharding is validated on a virtual CPU mesh per the driver contract (see
+__graft_entry__.dryrun_multichip). The image pins jax_platforms to
+"axon,cpu" at import time (the TPU tunnel) and ignores JAX_PLATFORMS, so we
+override via jax.config after import. XLA_FLAGS must still be set before
+jax initializes its CPU client.
+
+Set CBT_TEST_ON_TPU=1 to deliberately run the suite against the real chip.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if not os.environ.get("CBT_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

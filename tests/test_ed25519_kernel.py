@@ -1,0 +1,124 @@
+"""Differential tests: JAX batched ed25519 verifier vs the Python oracle.
+
+This is the CPU-reference-vs-device differential harness SURVEY.md §4 calls
+for, including the invalid-signature blame path and ZIP-215 edge cases.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_kernel as k
+
+
+def make_sigs(n, msg_fn=lambda i: b"msg-%d" % i):
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pubs = [ed.pubkey_from_seed(s) for s in seeds]
+    msgs = [msg_fn(i) for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+    return pubs, msgs, sigs
+
+
+def test_all_valid_batch():
+    pubs, msgs, sigs = make_sigs(5)
+    got = k.verify_batch(pubs, msgs, sigs)
+    assert got.shape == (5,)
+    assert got.all()
+
+
+def test_blame_path_mixed_batch():
+    pubs, msgs, sigs = make_sigs(8)
+    bad = dict()
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    bad[2] = True
+    msgs[5] = msgs[5] + b"tampered"
+    bad[5] = True
+    sigs[6] = sigs[6][:32] + int.to_bytes(
+        int.from_bytes(sigs[6][32:], "little") + ed.L, 32, "little"
+    )  # S >= L: malleability reject in precheck
+    bad[6] = True
+    got = k.verify_batch(pubs, msgs, sigs)
+    for i in range(8):
+        assert got[i] == (i not in bad), i
+        assert got[i] == ed.verify(pubs[i], msgs[i], sigs[i]), i
+
+
+def test_matches_oracle_on_garbage():
+    rng = np.random.default_rng(3)
+    pubs, msgs, sigs = [], [], []
+    for i in range(16):
+        pubs.append(rng.bytes(32))
+        msgs.append(rng.bytes(i))
+        sigs.append(rng.bytes(64))
+    got = k.verify_batch(pubs, msgs, sigs)
+    exp = [ed.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+
+
+def test_zip215_edges():
+    """Non-canonical encodings and small-order points must match the oracle
+    bit-for-bit — consensus forks otherwise."""
+    # identity-key / zero signature (valid under ZIP-215 cofactored eq)
+    ident = ed.pt_compress(ed.IDENT)
+    cases = [(ident, b"m", ident + b"\x00" * 32)]
+    # non-canonical R: y + p for a small-y point on the curve
+    for y in range(19):
+        u, v = (y * y - 1) % ed.P, (ed.D * y * y + 1) % ed.P
+        ok, x = ed._sqrt_ratio(u, v)
+        if ok:
+            enc_nc = int.to_bytes((y + ed.P) | ((x & 1) << 255), 32, "little")
+            break
+    seed = bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    sig = ed.sign(seed, b"x")
+    cases.append((pub, b"x", enc_nc + sig[32:]))  # valid-format, wrong R
+    cases.append((enc_nc, b"x", sig))  # non-canonical pubkey
+    # sign-bit edge: y=1 (x=0) with sign bit set
+    neg_zero = int.to_bytes(1 | (1 << 255), 32, "little")
+    cases.append((neg_zero, b"m", neg_zero + b"\x00" * 32))
+    pubs, msgs, sigs = zip(*cases)
+    got = k.verify_batch(list(pubs), list(msgs), list(sigs))
+    exp = [ed.verify(p, m, s) for p, m, s in cases]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    # sanity: at least one of these exotic cases is actually valid
+    assert any(exp)
+
+
+def test_fused_tally_quorum():
+    import jax.numpy as jnp
+
+    pubs, msgs, sigs = make_sigs(12)
+    sigs[3] = sigs[3][:20] + bytes([sigs[3][20] ^ 4]) + sigs[3][21:]
+    pb = k.pack_batch(pubs, msgs, sigs)
+    powers = np.array([10] * 6 + [5] * 6, dtype=np.int64)
+    power5 = np.zeros((pb.padded, k.POWER_LIMBS), np.int32)
+    power5[:12] = k.power_limbs(powers)
+    counted = np.zeros((pb.padded,), np.bool_)
+    counted[:12] = True
+    counted[7] = False  # e.g. a nil-vote: verified but not tallied
+    commit_ids = np.zeros((pb.padded,), np.int32)
+    commit_ids[6:12] = 1  # two commits in one batch
+    # commit 0: powers 10*6 minus invalid idx3 -> 50; commit 1: 5*6 minus
+    # uncounted idx7 -> 25
+    thresh = np.zeros((2, k.TALLY_LIMBS), np.int32)
+    thresh[0, 0] = 49
+    thresh[1, 0] = 25
+    valid, tally, quorum = k.verify_tally_kernel(
+        pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig, pb.precheck,
+        jnp.asarray(power5), jnp.asarray(counted), jnp.asarray(commit_ids),
+        jnp.asarray(thresh), n_commits=2,
+    )
+    t = k.tally_to_int(np.asarray(tally))
+    assert int(t[0]) == 50 and int(t[1]) == 25
+    assert bool(quorum[0]) and not bool(quorum[1])
+    exp_valid = [i != 3 for i in range(12)]
+    np.testing.assert_array_equal(np.asarray(valid)[:12], exp_valid)
+
+
+def test_large_power_tally_limbs():
+    """Voting powers near MaxTotalVotingPower stay exact in limb arithmetic."""
+    p = np.array([2**60, 2**60 - 1, 12345678901234567], dtype=np.int64)
+    limbs = k.power_limbs(p)
+    back = k.tally_to_int(limbs)
+    assert [int(x) for x in back] == [int(v) for v in p]

@@ -1,0 +1,43 @@
+"""Sharded verify+tally over the virtual 8-device CPU mesh."""
+import numpy as np
+
+import jax
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_kernel as k
+from cometbft_tpu.parallel import mesh as pm
+
+
+def test_sharded_matches_single_device():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    n = 24
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pubs = [ed.pubkey_from_seed(s) for s in seeds]
+    msgs = [b"commit-sig-%d" % i for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+    sigs[4] = sigs[4][:8] + bytes([sigs[4][8] ^ 2]) + sigs[4][9:]
+
+    pb = k.pack_batch(pubs, msgs, sigs, pad_to=64)
+    powers = np.arange(1, n + 1, dtype=np.int64) * 1000
+    power5 = np.zeros((pb.padded, k.POWER_LIMBS), np.int32)
+    power5[:n] = k.power_limbs(powers)
+    counted = np.zeros((pb.padded,), np.bool_)
+    counted[:n] = True
+    commit_ids = np.zeros((pb.padded,), np.int32)
+    commit_ids[n // 2 :] = 1
+    thresh = np.zeros((2, k.TALLY_LIMBS), np.int32)
+    thresh[0, 0] = 1
+    thresh[1, 0] = 2
+
+    mesh = pm.make_mesh()
+    step = pm.sharded_verify_tally(mesh, n_commits=2)
+    pb2, args = pm.shard_batch_arrays(mesh, pb, power5, counted, commit_ids)
+    valid, tally, quorum = step(*args, thresh)
+
+    exp_valid = np.array([i != 4 for i in range(n)])
+    np.testing.assert_array_equal(np.asarray(valid)[:n], exp_valid)
+    t = k.tally_to_int(np.asarray(tally))
+    exp0 = sum(int(powers[i]) for i in range(n // 2) if i != 4)
+    exp1 = sum(int(powers[i]) for i in range(n // 2, n))
+    assert int(t[0]) == exp0 and int(t[1]) == exp1
+    assert bool(quorum[0]) and bool(quorum[1])
