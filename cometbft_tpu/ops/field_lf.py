@@ -1,0 +1,169 @@
+"""Limbs-first (transposed) field arithmetic for Pallas TPU kernels.
+
+Same algorithms as cometbft_tpu.ops.field (13-bit x 20 int32 limbs), but
+with the LIMB axis first and the batch in trailing lanes: a field element
+batch is (NLIMBS, B). On TPU the last dim maps to the 128-wide lane axis,
+so every field op vectorizes perfectly across the signature batch while
+limb shifts become cheap sublane moves. The (..., NLIMBS) layout of
+field.Field would waste 108/128 lanes inside a kernel.
+
+Kept separate from field.Field on purpose: this module is the in-kernel
+(VMEM-resident) dialect used by ops.ed25519_pallas; field.Field remains the
+host/XLA dialect. The numeric discipline (mul-safe bound |l| <= 2^13+2^4,
+double-carry after wide ops) is identical — see field.py for the bound
+derivations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops.field import LIMB_BITS, MASK, NLIMBS, Field
+
+
+class FieldLF:
+    """Limbs-first view over a Field's constants."""
+
+    def __init__(self, f: Field):
+        self.f = f
+        self.p = f.p
+        # (NLIMBS, 1) column constants broadcast over lanes
+        self.fold260_col = f.fold260.reshape(NLIMBS, 1)
+        self.fold_top_col = f.fold_top.reshape(NLIMBS, 1)
+        self.bias64p_col = f.bias64p.reshape(NLIMBS, 1)
+        self.p_col = f.p_limbs.reshape(NLIMBS, 1)
+        self.shift_top = f.shift - LIMB_BITS * (NLIMBS - 1)
+
+    def const_col(self, v: int) -> np.ndarray:
+        return self.f.from_int(v).reshape(NLIMBS, 1)
+
+    # -- carries --------------------------------------------------------------
+
+    def carry(self, x):
+        """Two-pass parallel carry; see field.Field.carry for the contract."""
+        c = x >> LIMB_BITS
+        x = x - (c << LIMB_BITS)
+        x = x + jnp.pad(c[:-1], ((1, 0), (0, 0)))
+        x = x + c[-1:] * self.fold260_col
+        c = x >> LIMB_BITS
+        c = c.at[-1].set(0)
+        x = x - (c << LIMB_BITS)
+        return x + jnp.pad(c[:-1], ((1, 0), (0, 0)))
+
+    def add(self, a, b):
+        return self.carry(a + b)
+
+    def sub(self, a, b):
+        return self.carry(a - b)
+
+    def neg(self, a):
+        return -a
+
+    def mul_small(self, a, k: int):
+        assert 0 < abs(k) < 2**17
+        return self.carry(self.carry(a * jnp.int32(k)))
+
+    # -- multiply -------------------------------------------------------------
+
+    def mul(self, a, b):
+        wide = 2 * NLIMBS - 1
+        acc = jnp.zeros((wide,) + a.shape[1:], jnp.int32)
+        for i in range(NLIMBS):
+            acc = acc.at[i : i + NLIMBS].add(a[i : i + 1] * b)
+        return self._reduce_wide(acc)
+
+    def square(self, a):
+        """Schoolbook square using symmetry: ~half the partial products."""
+        wide = 2 * NLIMBS - 1
+        acc = jnp.zeros((wide,) + a.shape[1:], jnp.int32)
+        for i in range(NLIMBS):
+            # diagonal term
+            acc = acc.at[2 * i].add(a[i] * a[i])
+            # off-diagonal doubled terms j > i
+            if i + 1 < NLIMBS:
+                acc = acc.at[2 * i + 1 : i + NLIMBS].add(
+                    (2 * a[i : i + 1]) * a[i + 1 :]
+                )
+        return self._reduce_wide(acc)
+
+    def _pcarry_wide(self, x):
+        c = x >> LIMB_BITS
+        x = x - (c << LIMB_BITS)
+        x = jnp.pad(x, ((0, 1),) + ((0, 0),) * (x.ndim - 1))
+        return x.at[1:].add(c)
+
+    def _reduce_wide(self, acc):
+        guard = 0
+        while acc.shape[0] > NLIMBS:
+            guard += 1
+            assert guard < 8
+            acc = self._pcarry_wide(acc)
+            acc = self._pcarry_wide(acc)
+            high = acc[NLIMBS:]
+            low = acc[:NLIMBS]
+            nh = high.shape[0]
+            w = max(NLIMBS, self.f.max_off + nh)
+            buf = jnp.pad(low, ((0, w - NLIMBS),) + ((0, 0),) * (low.ndim - 1))
+            for off, m in self.f.fold_pairs:
+                buf = buf.at[off : off + nh].add(high * jnp.int32(m))
+            acc = buf
+        return self.carry(self.carry(acc))
+
+    # -- exponentiation -------------------------------------------------------
+
+    def pow2k(self, x, k: int):
+        """x^(2^k) by k squarings (fori_loop)."""
+        return jax.lax.fori_loop(0, k, lambda _, v: self.square(v), x)
+
+    def pow_p58(self, x):
+        """x^((p-5)/8) for p = 2^255-19, i.e. x^(2^252 - 3).
+
+        Classic ladder (ref10-style): build x^(2^250-1) from doubling
+        chains, then two squarings and a final multiply.
+        """
+        x2 = self.mul(self.square(x), x)  # 2^2 - 1
+        x4 = self.mul(self.pow2k(x2, 2), x2)  # 2^4 - 1
+        x5 = self.mul(self.square(x4), x)  # 2^5 - 1
+        x10 = self.mul(self.pow2k(x5, 5), x5)
+        x20 = self.mul(self.pow2k(x10, 10), x10)
+        x40 = self.mul(self.pow2k(x20, 20), x20)
+        x50 = self.mul(self.pow2k(x40, 10), x10)
+        x100 = self.mul(self.pow2k(x50, 50), x50)
+        x200 = self.mul(self.pow2k(x100, 100), x100)
+        x250 = self.mul(self.pow2k(x200, 50), x50)
+        return self.mul(self.pow2k(x250, 2), x)  # 2^252 - 3
+
+    # -- canonicalization -----------------------------------------------------
+
+    def canonical(self, x):
+        x = x + self.bias64p_col
+        for _ in range(2):
+            x = self._ripple(x)
+            hi = x[-1:] >> self.shift_top
+            x = x.at[-1].add(-(hi[0] << self.shift_top))
+            x = x + hi * self.fold_top_col
+        x = self._ripple(x)
+        t = self._ripple(x - self.p_col)
+        neg = t[-1:] < 0
+        return jnp.where(neg, x, t)
+
+    def _ripple(self, x):
+        outs = []
+        c = jnp.zeros_like(x[0])
+        for i in range(NLIMBS):
+            v = x[i] + c
+            if i < NLIMBS - 1:
+                c = v >> LIMB_BITS
+                v = v - (c << LIMB_BITS)
+            outs.append(v)
+        return jnp.stack(outs, axis=0)
+
+    def is_zero(self, x):
+        return jnp.all(self.canonical(x) == 0, axis=0)
+
+    def eq(self, a, b):
+        return self.is_zero(a - b)
+
+    def parity(self, x):
+        return self.canonical(x)[0] & 1
