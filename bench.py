@@ -1,17 +1,17 @@
 """Benchmark: 10k-validator commit verification (the BASELINE.json metric).
 
 Measures p50 latency of the fused device pass — batched ed25519 ZIP-215
-verification + voting-power quorum tally over a 10_000-signature commit —
-on whatever backend JAX_PLATFORMS selects (the driver runs it on the real
-TPU chip). Prints ONE JSON line.
+verification (Pallas TPU kernel) + voting-power quorum tally over a
+10_000-signature commit — on whatever backend JAX selects (the driver
+runs it on the real TPU chip). Prints ONE JSON line.
 
 Baseline: the reference's Go `crypto/batch` path (curve25519-voi batch
-verify) has no committed absolute numbers (BASELINE.md) and no Go toolchain
-exists in this image, so the CPU baseline is measured live with OpenSSL
-(`cryptography` package) single verifies divided by 1.7 — a generous stand-
-in for voi's batch speedup over single verification (voi's ZIP-215 batch is
-~1.5-2x single-verify throughput at size 1024; see reference
-crypto/ed25519/bench_test.go harness). vs_baseline = cpu_ms / device_ms.
+verify) has no committed absolute numbers (BASELINE.md) and no Go
+toolchain exists in this image, so the CPU baseline is measured live with
+OpenSSL (`cryptography` package) single verifies and scaled by an assumed
+voi batch speedup — both the raw measurement and the assumption are
+reported explicitly (`cpu_single_ms_meas`, `assumed_batch_speedup`).
+vs_baseline = cpu_est_ms / device_p50_ms.
 """
 import json
 import time
@@ -19,8 +19,8 @@ import time
 import numpy as np
 
 N_VALIDATORS = 10_000
-PAD = 16_384
-CPU_BATCH_SPEEDUP = 1.7
+PAD = 10_240  # multiple of the 128-lane Pallas tile; 80 grid steps
+ASSUMED_BATCH_SPEEDUP = 1.7  # voi ZIP-215 batch vs single, size ~1k (est.)
 
 
 def main():
@@ -28,37 +28,44 @@ def main():
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
-
-    import jax
-
-    from cometbft_tpu.ops import ed25519_kernel as k
-
-    # --- build a synthetic 10k-validator commit ---------------------------
-    sk = Ed25519PrivateKey.generate()
     from cryptography.hazmat.primitives.serialization import (
         Encoding,
         PublicFormat,
     )
 
-    # one key signing distinct messages models per-validator sign-bytes
-    # (cost profile on device is identical; packing cost is identical)
-    pub = sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
-    msgs = [b"vote-sign-bytes|h=12345|r=0|vote-%06d" % i for i in range(N_VALIDATORS)]
-    sigs = [sk.sign(m) for m in msgs]
-    pubs = [pub] * N_VALIDATORS
+    import jax
+
+    from cometbft_tpu.ops import ed25519_kernel as k
+    from cometbft_tpu.ops import ed25519_pallas as kp
+
+    # --- build a synthetic 10k-validator commit (distinct keys) -----------
+    n_keys = 64  # distinct signing keys, cycled (keygen cost cap)
+    sks = [Ed25519PrivateKey.generate() for _ in range(n_keys)]
+    pubs_pool = [
+        s.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        for s in sks
+    ]
+    msgs = [
+        b"vote-sign-bytes|h=12345|r=0|vote-%06d" % i
+        for i in range(N_VALIDATORS)
+    ]
+    sigs = [sks[i % n_keys].sign(m) for i, m in enumerate(msgs)]
+    pubs = [pubs_pool[i % n_keys] for i in range(N_VALIDATORS)]
 
     # --- CPU baseline: OpenSSL verify loop (sampled) ----------------------
-    pk = sk.public_key()
+    pk_objs = [s.public_key() for s in sks]  # hoisted: no per-verify serde
     sample = 500
     t = time.perf_counter()
     for i in range(sample):
-        pk.verify(sigs[i], msgs[i])
+        pk_objs[i % n_keys].verify(sigs[i], msgs[i])
     per_sig = (time.perf_counter() - t) / sample
-    cpu_ms = per_sig * N_VALIDATORS * 1000 / CPU_BATCH_SPEEDUP
+    cpu_single_ms = per_sig * N_VALIDATORS * 1000
+    cpu_est_ms = cpu_single_ms / ASSUMED_BATCH_SPEEDUP
 
     # --- pack + stage -----------------------------------------------------
     t = time.perf_counter()
     pb = k.pack_batch(pubs, msgs, sigs, pad_to=PAD)
+    targs = kp.pack_transposed(pb)
     pack_ms = (time.perf_counter() - t) * 1000
 
     powers = np.full((N_VALIDATORS,), 1000, np.int64)
@@ -69,21 +76,30 @@ def main():
     commit_ids = np.zeros((PAD,), np.int32)
     thresh = k.threshold_limbs(int(powers.sum()) * 2 // 3)
 
-    args = [
-        jax.device_put(a)
-        for a in (pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig,
-                  pb.precheck, power5, counted, commit_ids, thresh)
+    t = time.perf_counter()
+    args = [jax.device_put(a) for a in targs] + [
+        jax.device_put(a) for a in (power5, counted, commit_ids, thresh)
     ]
+    # device_put is async (and block_until_ready does not block on the
+    # axon tunnel backend) — fetch one element per array to pin the
+    # transfers before stopping the clock
+    for a in args:
+        np.asarray(a).ravel()[:1]
+    h2d_ms = (time.perf_counter() - t) * 1000
 
-    # --- device p50 -------------------------------------------------------
-    out = jax.block_until_ready(k.verify_tally_kernel(*args, n_commits=1))
-    assert bool(np.asarray(out[2])[0]), "quorum must hold on valid commit"
-    assert np.asarray(out[0])[:N_VALIDATORS].all()
+    # --- device p50 (quorum bit fetched each run — the happy-path output;
+    # np.asarray forces real completion, block_until_ready does not block
+    # on the axon tunnel backend) ------------------------------------------
+    valid, tally, quorum = kp.verify_tally_pallas(*args)
+    assert bool(np.asarray(quorum)[0]), "quorum must hold on valid commit"
+    assert np.asarray(valid)[:N_VALIDATORS].all()
     times = []
     for _ in range(10):
         t = time.perf_counter()
-        out = jax.block_until_ready(k.verify_tally_kernel(*args, n_commits=1))
+        _, _, quorum = kp.verify_tally_pallas(*args)
+        ok = bool(np.asarray(quorum)[0])
         times.append((time.perf_counter() - t) * 1000)
+        assert ok
     p50 = float(np.percentile(times, 50))
 
     print(
@@ -92,12 +108,17 @@ def main():
                 "metric": "10k-validator VerifyCommitLight fused p50",
                 "value": round(p50, 3),
                 "unit": "ms",
-                "vs_baseline": round(cpu_ms / p50, 2),
+                "vs_baseline": round(cpu_est_ms / p50, 2),
                 "extra": {
                     "device": str(jax.devices()[0]),
+                    "kernel": "pallas",
                     "sigs_per_sec": round(N_VALIDATORS / (p50 / 1000)),
-                    "cpu_baseline_ms": round(cpu_ms, 1),
+                    "cpu_single_ms_meas": round(cpu_single_ms, 1),
+                    "assumed_batch_speedup": ASSUMED_BATCH_SPEEDUP,
+                    "cpu_baseline_est_ms": round(cpu_est_ms, 1),
                     "host_pack_ms": round(pack_ms, 1),
+                    "h2d_ms": round(h2d_ms, 1),
+                    "end_to_end_ms": round(pack_ms + h2d_ms + p50, 1),
                     "min_ms": round(min(times), 3),
                     "total_bench_s": round(time.time() - t0, 1),
                 },

@@ -1,0 +1,88 @@
+"""Key types: ed25519 keys, signing, addresses — the crypto.PubKey /
+crypto.PrivKey surface.
+
+Reference: crypto/crypto.go:22-42 (interfaces, Address = SumTruncated),
+crypto/ed25519/ed25519.go:109 (Sign), :156 (GenPrivKey), :181
+(VerifySignature).
+
+Signing uses OpenSSL (`cryptography` package) — constant-time, C speed.
+Single verification uses the pure-Python ZIP-215 oracle
+(crypto/ed25519_ref.py), NOT OpenSSL: OpenSSL's Ed25519 verify is
+cofactorless and rejects some encodings ZIP-215 accepts, and the
+reference pins ZIP-215 semantics for consensus compatibility
+(crypto/ed25519/ed25519.go:40-42). CPU-vs-device agreement matters more
+than single-verify speed — bulk verification routes to the TPU kernel.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    PublicFormat,
+)
+
+from cometbft_tpu.crypto import ed25519_ref
+from cometbft_tpu.crypto import tmhash
+
+ED25519_KEY_TYPE = "ed25519"
+SECP256K1_KEY_TYPE = "secp256k1"
+
+
+@dataclass(frozen=True)
+class PubKey:
+    """An ed25519 public key (32 raw bytes)."""
+
+    data: bytes
+    key_type: str = ED25519_KEY_TYPE
+
+    def address(self) -> bytes:
+        """20-byte address: SHA256(pubkey)[:20] (crypto/crypto.go:18)."""
+        return tmhash.sum_truncated(self.data)
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """ZIP-215 single verify (crypto/ed25519/ed25519.go:181)."""
+        return ed25519_ref.verify(self.data, msg, sig)
+
+    def __bytes__(self) -> bytes:
+        return self.data
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    """An ed25519 private key: 64 bytes = seed || pubkey (RFC 8032 / Go
+    crypto/ed25519 layout, which the reference inherits)."""
+
+    data: bytes
+
+    @staticmethod
+    def generate(seed: Optional[bytes] = None) -> "PrivKey":
+        if seed is None:
+            sk = Ed25519PrivateKey.generate()
+            seed = sk.private_bytes(
+                Encoding.Raw, PrivateFormat.Raw, NoEncryption()
+            )
+        assert len(seed) == 32
+        pub = (
+            Ed25519PrivateKey.from_private_bytes(seed)
+            .public_key()
+            .public_bytes(Encoding.Raw, PublicFormat.Raw)
+        )
+        return PrivKey(seed + pub)
+
+    @property
+    def seed(self) -> bytes:
+        return self.data[:32]
+
+    def pub_key(self) -> PubKey:
+        return PubKey(self.data[32:])
+
+    def sign(self, msg: bytes) -> bytes:
+        """RFC 8032 deterministic signature via OpenSSL."""
+        return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)

@@ -177,8 +177,12 @@ def scalar_mul_windowed(digits, p):
 _BASE_TABLE = None
 
 
-def base_table() -> jnp.ndarray:
-    """(64, 16, 4, NLIMBS) comb table: entry [w][d] = [d * 16^w]B."""
+def base_table_np() -> np.ndarray:
+    """(64, 16, 4, NLIMBS) comb table as NUMPY: entry [w][d] = [d * 16^w]B.
+
+    Numpy on purpose — callers that need the table inside a jit trace (the
+    Pallas kernel's f32 comb input) must build from numpy, never from a
+    jnp value produced under the trace."""
     global _BASE_TABLE
     if _BASE_TABLE is None:
         rows = []
@@ -191,8 +195,13 @@ def base_table() -> jnp.ndarray:
                 x, y = pt[0] * zi % ref.P, pt[1] * zi % ref.P
                 row.append(from_affine_int(x, y))
             rows.append(np.stack(row))
-        _BASE_TABLE = np.stack(rows)  # numpy: safe to close over in traces
-    return jnp.asarray(_BASE_TABLE)
+        _BASE_TABLE = np.stack(rows)
+    return _BASE_TABLE
+
+
+def base_table() -> jnp.ndarray:
+    """jnp view of base_table_np (safe to close over: built from numpy)."""
+    return jnp.asarray(base_table_np())
 
 
 def base_scalar_mul(digits):

@@ -21,6 +21,7 @@ batches up to 2^17 signatures.
 """
 from __future__ import annotations
 
+import hashlib
 from functools import partial
 from typing import NamedTuple, Optional, Sequence
 
@@ -61,6 +62,35 @@ def scalar_digits(v: int) -> np.ndarray:
     lo = b & 0xF
     hi = b >> 4
     return np.stack([lo, hi], axis=1).reshape(64).astype(np.int32)
+
+
+def nibbles(b: np.ndarray) -> np.ndarray:
+    """(..., 32) uint8 -> (..., 64) int32 base-16 digits, little-endian.
+
+    Batched scalar_digits — one numpy pass for the whole batch."""
+    lo = (b & 0xF).astype(np.int32)
+    hi = (b >> 4).astype(np.int32)
+    return np.stack([lo, hi], axis=-1).reshape(b.shape[:-1] + (64,))
+
+
+_L_WORDS = np.frombuffer(int.to_bytes(ref.L, 32, "little"), np.uint8).view(
+    "<u8"
+)
+
+
+def s_below_l(s_bytes: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 little-endian S -> (B,) bool S < L, vectorized.
+
+    The malleability precheck of crypto/ed25519/ed25519.go:189 (S < order),
+    done as a lexicographic compare on 4 little-endian uint64 words."""
+    w = np.ascontiguousarray(s_bytes).view("<u8")  # (B, 4)
+    lt = np.zeros(s_bytes.shape[0], np.bool_)
+    decided = np.zeros(s_bytes.shape[0], np.bool_)
+    for i in range(3, -1, -1):
+        lw = _L_WORDS[i]
+        lt |= ~decided & (w[:, i] < lw)
+        decided |= w[:, i] != lw
+    return lt
 
 
 def power_limbs(powers: np.ndarray) -> np.ndarray:
@@ -121,32 +151,59 @@ def pack_batch(
     padded = pad_to if pad_to is not None else bucket_size(max(n, 1))
     assert padded >= n
 
-    ay = np.zeros((padded, NLIMBS), np.int32)
-    ry = np.zeros((padded, NLIMBS), np.int32)
-    asign = np.zeros((padded,), np.int32)
-    rsign = np.zeros((padded,), np.int32)
-    sdig = np.zeros((padded, 64), np.int32)
-    hdig = np.zeros((padded, 64), np.int32)
-    precheck = np.zeros((padded,), np.bool_)
+    # Length screen first; malformed rows keep zeroed payloads and
+    # precheck=False (they verify invalid without poisoning the batch).
+    lenok = [
+        len(p) == 32 and len(s) == 64 for p, s in zip(pubkeys, sigs)
+    ]
 
     a_raw = np.zeros((padded, 32), np.uint8)
     r_raw = np.zeros((padded, 32), np.uint8)
+    s_raw = np.zeros((padded, 32), np.uint8)
+    sha512 = hashlib.sha512
+    if all(lenok):
+        # fast path: single join + frombuffer per array (no per-row numpy)
+        a_raw[:n] = np.frombuffer(b"".join(pubkeys), np.uint8).reshape(n, 32)
+        sig_cat = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
+        r_raw[:n] = sig_cat[:, :32]
+        s_raw[:n] = sig_cat[:, 32:]
+        # SHA-512 stays a host loop (C speed); everything downstream of
+        # the digests is vectorized
+        digests = [
+            sha512(sig[:32] + pk + msg).digest()
+            for pk, msg, sig in zip(pubkeys, msgs, sigs)
+        ]
+        lenok_np = np.ones(n, np.bool_)
+    else:
+        digests = [b"\x00" * 64] * n
+        for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+            if not lenok[i]:
+                continue
+            a_raw[i] = np.frombuffer(pk, np.uint8)
+            r_raw[i] = np.frombuffer(sig[:32], np.uint8)
+            s_raw[i] = np.frombuffer(sig[32:], np.uint8)
+            digests[i] = sha512(sig[:32] + pk + msg).digest()
+        lenok_np = np.asarray(lenok, np.bool_)
 
-    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
-        if len(pk) != 32 or len(sig) != 64:
-            continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= ref.L:
-            continue  # malleability reject (both ZIP-215 and RFC 8032)
-        a_raw[i] = np.frombuffer(pk, np.uint8)
-        r_raw[i] = np.frombuffer(sig[:32], np.uint8)
-        asign[i] = pk[31] >> 7
-        rsign[i] = sig[31] >> 7
-        sdig[i] = scalar_digits(s)
-        h = ref.challenge_scalar(sig[:32], pk, msg)
-        hdig[i] = scalar_digits(h)
-        precheck[i] = True
+    # h = digest mod L: C-bigint per row (sub-microsecond), then one
+    # vectorized nibble split for the whole batch
+    h_bytes = np.zeros((padded, 32), np.uint8)
+    if n:
+        from_b, to_b = int.from_bytes, int.to_bytes
+        h_bytes[:n] = np.frombuffer(
+            b"".join(
+                to_b(from_b(d, "little") % ref.L, 32, "little")
+                for d in digests
+            ),
+            np.uint8,
+        ).reshape(n, 32)
 
+    precheck = np.zeros((padded,), np.bool_)
+    precheck[:n] = lenok_np & s_below_l(s_raw[:n])
+    sdig = nibbles(s_raw)
+    hdig = nibbles(h_bytes)
+    asign = (a_raw[:, 31] >> 7).astype(np.int32)
+    rsign = (r_raw[:, 31] >> 7).astype(np.int32)
     ay = F.from_bytes_le(a_raw, nbits=255)
     ry = F.from_bytes_le(r_raw, nbits=255)
     return PackedBatch(n, padded, ay, asign, ry, rsign, sdig, hdig, precheck)

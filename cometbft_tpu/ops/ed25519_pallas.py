@@ -1,27 +1,28 @@
 """Pallas TPU kernel: fully fused batched ed25519 ZIP-215 verification.
 
 The XLA-composed kernel (ops.ed25519_kernel) is HBM-bound: every field op
-materializes (B, 39) int32 intermediates, ~600 GB of traffic for a 16k
-batch. This kernel keeps the entire per-signature computation — point
-decompression (sqrt chain), the per-signature 16-entry table, 63 window
-iterations of the double-and-add loop, the base-point comb, cofactor
-clearing and the identity check — VMEM-resident per 128-lane tile, with the
-limb axis on sublanes (see ops.field_lf for the layout rationale).
+materializes (B, 39) int32 intermediates in HBM. This kernel keeps the
+entire per-signature computation — point decompression (sqrt chain), the
+per-signature 16-entry table, 63 window iterations of the double-and-add
+loop, the base-point comb, cofactor clearing and the identity check —
+VMEM-resident per 128-lane tile, with the limb axis on sublanes (see
+ops.field_lf for the layout rationale).
 
-Two lookup strategies inside the kernel:
-  * per-signature table (h * -A): one-hot masked sum over the 16 VMEM
-    scratch entries (tables differ per lane, so no matmul is possible);
-  * base table ([S]B comb): float32 one-hot matmul (80, 16) @ (16, B) on
-    the MXU — table values are < 2^13 so f32 is exact, and each output
-    column is a single table entry (no accumulation).
+Mosaic constraints shape the design:
+  * no captured array constants — field constants are materialized
+    in-trace from Python ints (field_lf.const_col), and the base-point
+    comb table is an explicit kernel input;
+  * the per-signature table (entries [d](-A), d<16) is built with a
+    statically unrolled loop and kept as a loop-invariant VMEM value;
+    lookups are one-hot masked sums (tables differ per lane);
+  * the base comb ([S]B) lookup is a float32 one-hot matmul on the MXU —
+    table limbs are < 2^13 so f32 is exact, and each output column is a
+    single table entry (no accumulation).
 
-Semantics are identical to ops.ed25519_kernel.verify_core (differential-
-tested); the reference seam is the same: crypto/ed25519/ed25519.go:208-241
-BatchVerifier + types/validation.go:153 verifyCommitBatch.
+Reference seam (same as ops.ed25519_kernel): crypto/ed25519/ed25519.go:
+208-241 BatchVerifier + types/validation.go:153 verifyCommitBatch.
 """
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -32,14 +33,16 @@ from jax.experimental.pallas import tpu as pltpu
 from cometbft_tpu.crypto import ed25519_ref as ref
 from cometbft_tpu.ops import curve25519 as curve_hl
 from cometbft_tpu.ops.field import F25519, NLIMBS
-from cometbft_tpu.ops.field_lf import FieldLF
+from cometbft_tpu.ops.field_lf import FieldLF, const_col
 
 F = FieldLF(F25519)
 B_TILE = 128
 
-_D_COL = F.const_col(ref.D)
-_D2_COL = F.const_col(2 * ref.D % ref.P)
-_SQRT_M1_COL = F.const_col(ref.SQRT_M1)
+# field constants as Python limb tuples (materialized in-trace, never captured)
+_D_T = F.const_limbs(ref.D)
+_D2_T = F.const_limbs(2 * ref.D % ref.P)
+_SQRT_M1_T = F.const_limbs(ref.SQRT_M1)
+_ONE_T = (1,) + (0,) * (NLIMBS - 1)
 
 
 # --------------------------------------------------------------------------
@@ -47,12 +50,12 @@ _SQRT_M1_COL = F.const_col(ref.SQRT_M1)
 # --------------------------------------------------------------------------
 
 
-def pt_add(p, q):
+def pt_add(p, q, d2_col):
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
     A = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
     B = F.mul(F.add(Y1, X1), F.add(Y2, X2))
-    C = F.mul(F.mul(T1, T2), _D2_COL)
+    C = F.mul(F.mul(T1, T2), d2_col)
     Dv = F.mul_small(F.mul(Z1, Z2), 2)
     E = F.sub(B, A)
     Fv = F.sub(Dv, C)
@@ -62,7 +65,7 @@ def pt_add(p, q):
 
 
 def pt_double(p):
-    X1, Y1, Z1, _ = p
+    X1, Y1, Z1 = p[0], p[1], p[2]
     A = F.square(X1)
     B = F.square(Y1)
     C = F.mul_small(F.square(Z1), 2)
@@ -73,34 +76,72 @@ def pt_double(p):
     return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
 
 
+def pt_double_p(p):
+    """Projective doubling, T dropped (3M+4S vs 4M+4S).
+
+    Legal whenever the next op is another doubling — only an add consumes
+    T. Returns a 3-tuple (X, Y, Z); feed pt_double (which ignores T) to
+    re-extend on the last doubling before an add."""
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    A = F.square(X1)
+    B = F.square(Y1)
+    C = F.mul_small(F.square(Z1), 2)
+    H = F.add(A, B)
+    E = F.sub(H, F.square(F.add(X1, Y1)))
+    G = F.sub(A, B)
+    Fv = F.add(C, G)
+    return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G))
+
+
+def pt_add_noT(p, q, d2_col):
+    """Unified add with the T output dropped (8M) — for results that are
+    never re-added (the final accumulation before the identity check)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = F.mul(F.sub(Y1, X1), F.sub(Y2, X2))
+    B = F.mul(F.add(Y1, X1), F.add(Y2, X2))
+    C = F.mul(F.mul(T1, T2), d2_col)
+    Dv = F.mul_small(F.mul(Z1, Z2), 2)
+    E = F.sub(B, A)
+    Fv = F.sub(Dv, C)
+    G = F.add(Dv, C)
+    H = F.add(B, A)
+    return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G))
+
+
 def pt_neg(p):
     X, Y, Z, T = p
     return (-X, Y, Z, -T)
 
 
 def pt_identity(b):
-    one = jnp.zeros((NLIMBS, b), jnp.int32).at[0].set(1)
+    one = const_col(_ONE_T, b)
     zero = jnp.zeros((NLIMBS, b), jnp.int32)
     return (zero, one, one, zero)
 
 
-def decompress(y, sign_row):
-    """ZIP-215 decompression; y (NLIMBS, B), sign_row (1, B) -> (pt, ok)."""
+def decompress(y, sign_row, d_col, sqrt_m1_col):
+    """ZIP-215 decompression; y (NLIMBS, B), sign_row (1, B) -> (pt, ok).
+
+    ok is (1, B) bool; on ok=False the point contents are garbage and the
+    caller must mask. Mirrors ed25519_ref.pt_decompress (zip215=True).
+    """
+    b = y.shape[1]
     yy = F.square(y)
-    one = jnp.zeros_like(y).at[0].set(1)
+    one = const_col(_ONE_T, b)
     u = F.sub(yy, one)
-    v = F.add(F.mul(yy, _D_COL), one)
+    v = F.add(F.mul(yy, d_col), one)
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
     r = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
     check = F.mul(v, F.square(r))
-    is_pos = F.eq(check, u)
-    is_neg = F.is_zero(check + u)
+    is_pos = F.eq(check, u)  # (1, B)
+    is_neg = F.is_zero(check + u)  # check == -u
     ok = is_pos | is_neg
-    r = jnp.where(is_neg[None, :], F.mul(r, _SQRT_M1_COL), r)
-    flip = (F.parity(r) != sign_row[0])[None, :]
+    r = jnp.where(is_neg, F.mul(r, sqrt_m1_col), r)
+    flip = F.parity(r) != sign_row
     x = jnp.where(flip, -r, r)
-    return (x, y, jnp.zeros_like(y).at[0].set(1), F.mul(x, y)), ok
+    return (x, y, one, F.mul(x, y)), ok
 
 
 # --------------------------------------------------------------------------
@@ -109,89 +150,106 @@ def decompress(y, sign_row):
 
 
 def _kernel(ay_ref, asign_ref, ry_ref, rsign_ref, sdig_ref, hdig_ref,
-            pre_ref, base_ref, valid_ref, tbl):
+            pre_ref, base_ref, valid_ref):
     b = B_TILE
-    A, ok_a = decompress(ay_ref[:, :], asign_ref[:, :])
-    R, ok_r = decompress(ry_ref[:, :], rsign_ref[:, :])
+    d_col = const_col(_D_T, b)
+    d2_col = const_col(_D2_T, b)
+    sqrt_m1_col = const_col(_SQRT_M1_T, b)
+
+    A, ok_a = decompress(ay_ref[:, :], asign_ref[:, :], d_col, sqrt_m1_col)
+    R, ok_r = decompress(ry_ref[:, :], rsign_ref[:, :], d_col, sqrt_m1_col)
     negA = pt_neg(A)
 
-    # per-signature table tbl[d] = [d](-A), d in 0..15
-    def build(d, pt):
-        tbl[d] = jnp.stack(pt)
-        return pt_add(pt, negA)
-
-    jax.lax.fori_loop(0, 16, build, pt_identity(b))
+    # per-signature table entries [d](-A), d in 0..15 — statically unrolled,
+    # kept as one loop-invariant VMEM value (16, 4, NLIMBS, B)
+    entries = []
+    pt = pt_identity(b)
+    for d in range(16):
+        entries.append(jnp.stack(pt))
+        if d < 15:
+            pt = pt_add(pt, negA, d2_col)
+    tbl = jnp.stack(entries)
 
     def lookup(d_row):
+        """d_row (1, B) -> table entry per lane, one-hot masked sum."""
         ent = jnp.zeros((4, NLIMBS, b), jnp.int32)
         for dv in range(16):
             m = (d_row == dv)[None]  # (1, 1, B)
             ent = ent + jnp.where(m, tbl[dv], 0)
         return (ent[0], ent[1], ent[2], ent[3])
 
-    # h * (-A): 63 windows of 4 doublings + 1 table add
+    # h * (-A): 63 windows of 4 doublings + 1 table add (Horner, base 16);
+    # doublings 1-3 stay projective (3M+4S), the 4th re-extends T for the add
     def win_body(i, pt):
         w = 62 - i
-        pt = pt_double(pt_double(pt_double(pt_double(pt))))
+        pt = pt_double(pt_double_p(pt_double_p(pt_double_p(pt))))
         d_row = hdig_ref[pl.ds(w, 1), :]
-        return pt_add(pt, lookup(d_row))
+        return pt_add(pt, lookup(d_row), d2_col)
 
     h_negA = jax.lax.fori_loop(
         0, 63, win_body, lookup(hdig_ref[63:64, :])
     )
 
-    # [S]B comb: 64 windows, each an f32 one-hot matmul into the MXU
+    # [S]B comb: 64 windows, each an f32 one-hot matmul on the MXU.
+    # base_ref rows are (window*16 + digit) -> flattened point (4*NLIMBS,)
     iota16 = jax.lax.broadcasted_iota(jnp.int32, (16, b), 0)
 
     def base_body(w, pt):
         d_row = sdig_ref[pl.ds(w, 1), :]
         oh = (iota16 == d_row).astype(jnp.float32)  # (16, B)
-        t_w = base_ref[:, pl.ds(w * 16, 16)]  # (80, 16) f32
+        t_w = base_ref[pl.ds(w * 16, 16), :]  # (16, 80) f32
         ent = jax.lax.dot_general(
-            t_w, oh, (((1,), (0,)), ((), ())),
+            t_w, oh, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            # HIGHEST forces exact f32 (multi-pass bf16) — the v5e MXU's
+            # default single-pass bf16 rounds 13-bit limbs (8-bit mantissa)
+            precision=jax.lax.Precision.HIGHEST,
         ).astype(jnp.int32)  # (80, B), exact: one-hot selects single values
         e = ent.reshape(4, NLIMBS, b)
-        return pt_add(pt, (e[0], e[1], e[2], e[3]))
+        return pt_add(pt, (e[0], e[1], e[2], e[3]), d2_col)
 
     sB = jax.lax.fori_loop(0, 64, base_body, pt_identity(b))
 
-    W = pt_add(pt_add(sB, h_negA), pt_neg(R))
-    W8 = pt_double(pt_double(pt_double(W)))
-    eq = F.is_zero(W8[0]) & F.eq(W8[1], W8[2])
-    valid = eq & ok_a & ok_r & (pre_ref[0, :] != 0)
-    valid_ref[0, :] = valid.astype(jnp.int32)
+    W = pt_add_noT(pt_add(sB, h_negA, d2_col), pt_neg(R), d2_col)
+    W8 = pt_double_p(pt_double_p(pt_double_p(W)))
+    eq = F.is_zero(W8[0]) & F.eq(W8[1], W8[2])  # (1, B)
+    valid = eq & ok_a & ok_r & (pre_ref[:, :] != 0)
+    valid_ref[:, :] = valid.astype(jnp.int32)
 
 
 _BASE_F32 = None
 
 
-def _base_f32() -> np.ndarray:
-    """Base comb table as (4*NLIMBS, 64*16) float32 (limbs exact in f32)."""
+def base_f32() -> np.ndarray:
+    """Base comb table as (64*16, 4*NLIMBS) float32; rows indexed by
+    window*16 + digit. Built eagerly from the numpy table — never inside
+    a trace (round-1 bug: jnp base_table() under jit raised
+    TracerArrayConversionError)."""
     global _BASE_F32
     if _BASE_F32 is None:
-        t = np.asarray(curve_hl.base_table())  # (64, 16, 4, NLIMBS)
+        t = curve_hl.base_table_np()  # numpy (64, 16, 4, NLIMBS)
         _BASE_F32 = np.ascontiguousarray(
-            t.transpose(2, 3, 0, 1).reshape(4 * NLIMBS, 64 * 16)
+            t.reshape(64 * 16, 4 * NLIMBS)
         ).astype(np.float32)
     return _BASE_F32
 
 
 @jax.jit
-def verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck):
+def _verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base):
     """Fused verify over limbs-first arrays.
 
     ay_t/ry_t: (NLIMBS, B); asign/rsign/precheck: (1, B); sdig_t/hdig_t:
-    (64, B). B must be a multiple of B_TILE. Returns (B,) bool.
+    (64, B); base: (1024, 80) f32. B must be a multiple of B_TILE.
+    Returns (B,) bool.
     """
     B = ay_t.shape[1]
-    assert B % B_TILE == 0
+    assert B % B_TILE == 0, f"B={B} not a multiple of {B_TILE}"
     grid = (B // B_TILE,)
     col = lambda r: pl.BlockSpec(
         (r, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     full = pl.BlockSpec(
-        (4 * NLIMBS, 64 * 16), lambda i: (0, 0), memory_space=pltpu.VMEM
+        (64 * 16, 4 * NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM
     )
     out = pl.pallas_call(
         _kernel,
@@ -201,10 +259,50 @@ def verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck):
         in_specs=[col(NLIMBS), col(1), col(NLIMBS), col(1), col(64),
                   col(64), col(1), full],
         out_specs=col(1),
-        scratch_shapes=[pltpu.VMEM((16, 4, NLIMBS, B_TILE), jnp.int32)],
-    )(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
-      jnp.asarray(_base_f32()))
+    )(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base)
     return out[0] != 0
+
+
+def verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck):
+    """Public entry: supplies the base comb table (built outside any trace)."""
+    return _verify_pallas(
+        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
+        jnp.asarray(base_f32()),
+    )
+
+
+@jax.jit
+def _verify_tally_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
+                         base, power5, counted, commit_ids, threshold):
+    """Pallas verify + fused XLA tally/quorum in one compiled program.
+
+    The tally is one one-hot einsum + carry chain (ed25519_kernel.tally_core)
+    — negligible next to the curve work, so it rides the XLA side of the
+    same jit rather than the Mosaic kernel."""
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    valid = _verify_pallas.__wrapped__(
+        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base
+    )
+    n_commits = threshold.shape[0]
+    tally = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
+    return valid, tally, ek.quorum_core(tally, threshold)
+
+
+def verify_tally_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
+                        power5, counted, commit_ids, threshold):
+    return _verify_tally_pallas(
+        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
+        jnp.asarray(base_f32()), power5, counted, commit_ids, threshold,
+    )
+
+
+def pad_to_tile(n: int) -> int:
+    """Bucket size for the Pallas path: >= B_TILE and a multiple of it."""
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    b = ek.bucket_size(max(n, 1))
+    return max(b, B_TILE)
 
 
 def pack_transposed(pb):
@@ -224,6 +322,6 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     """Drop-in equivalent of ed25519_kernel.verify_batch via Pallas."""
     from cometbft_tpu.ops import ed25519_kernel as ek
 
-    pb = ek.pack_batch(pubkeys, msgs, sigs)
+    pb = ek.pack_batch(pubkeys, msgs, sigs, pad_to=pad_to_tile(len(pubkeys)))
     args = pack_transposed(pb)
     return np.asarray(verify_pallas(*args))[: pb.n]

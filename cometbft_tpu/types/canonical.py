@@ -1,0 +1,84 @@
+"""Canonical sign-bytes encodings — byte-exact with the reference.
+
+Reference: types/canonical.go (CanonicalizeVote/Proposal),
+proto/tendermint/types/canonical.proto (field numbers/types),
+canonical.pb.go MarshalToSizedBuffer (proto3 zero-skipping; non-nullable
+Timestamp always emitted), types/vote.go:139 VoteSignBytes (varint
+length-prefixed). Golden vectors: types/vote_test.go
+TestVoteSignBytesTestVectors — replicated in tests/test_canonical.py.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.timestamp import Timestamp
+
+# SignedMsgType enum (proto/tendermint/types/types.pb.go:45-48)
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+def canonical_block_id_body(bid: BlockID) -> bytes:
+    """CanonicalBlockID message body (hash field 1, part_set_header
+    field 2 non-nullable)."""
+    psh = pe.f_varint(1, bid.part_set_header.total) + pe.f_bytes(
+        2, bid.part_set_header.hash
+    )
+    return pe.f_bytes(1, bid.hash) + pe.f_msg(2, psh)
+
+
+def canonical_vote_bytes(
+    chain_id: str,
+    vote_type: int,
+    height: int,
+    round_: int,
+    block_id: Optional[BlockID],
+    ts: Timestamp,
+) -> bytes:
+    """Length-prefixed CanonicalVote — the exact bytes a validator signs.
+
+    block_id=None (or a nil BlockID) omits field 4 entirely
+    (types/canonical.go CanonicalizeBlockID returns nil for nil votes).
+    """
+    body = pe.f_varint(1, vote_type)
+    body += pe.f_sfixed64(2, height)
+    body += pe.f_sfixed64(3, round_)
+    if block_id is not None and not block_id.is_nil():
+        body += pe.f_msg(4, canonical_block_id_body(block_id))
+    body += pe.f_msg(5, pe.timestamp(ts.seconds, ts.nanos))
+    body += pe.f_bytes(6, chain_id.encode())
+    return pe.delimited(body)
+
+
+def canonical_proposal_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: Optional[BlockID],
+    ts: Timestamp,
+) -> bytes:
+    """Length-prefixed CanonicalProposal (types/proposal.go:112)."""
+    body = pe.f_varint(1, PROPOSAL_TYPE)
+    body += pe.f_sfixed64(2, height)
+    body += pe.f_sfixed64(3, round_)
+    body += pe.f_varint(4, pol_round)
+    if block_id is not None and not block_id.is_nil():
+        body += pe.f_msg(5, canonical_block_id_body(block_id))
+    body += pe.f_msg(6, pe.timestamp(ts.seconds, ts.nanos))
+    body += pe.f_bytes(7, chain_id.encode())
+    return pe.delimited(body)
+
+
+def canonical_vote_extension_bytes(
+    chain_id: str, height: int, round_: int, extension: bytes
+) -> bytes:
+    """Length-prefixed CanonicalVoteExtension (types/vote.go:154)."""
+    body = pe.f_bytes(1, extension)
+    body += pe.f_sfixed64(2, height)
+    body += pe.f_sfixed64(3, round_)
+    body += pe.f_bytes(4, chain_id.encode())
+    return pe.delimited(body)

@@ -1,0 +1,35 @@
+"""Timestamp: (seconds, nanos) pair matching google.protobuf.Timestamp.
+
+Stored as raw ints, not a datetime: the pair is signed over byte-exactly
+(types/canonical.go), and Go's zero time (0001-01-01T00:00:00Z) encodes
+as seconds = -62135596800 — outside datetime-friendly ranges. Reference
+canonicalization (types/time/time.go Canonical) is Round(0).UTC(), i.e.
+strip monotonic clock + force UTC — a no-op on a plain pair.
+"""
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+
+# Seconds of Go's zero time relative to the Unix epoch.
+GO_ZERO_SECONDS = -62135596800
+
+
+@dataclass(frozen=True, order=True)
+class Timestamp:
+    seconds: int = GO_ZERO_SECONDS
+    nanos: int = 0
+
+    @staticmethod
+    def now() -> "Timestamp":
+        ns = _time.time_ns()
+        return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
+
+    def is_zero(self) -> bool:
+        return self.seconds == GO_ZERO_SECONDS and self.nanos == 0
+
+    def to_ns(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+
+ZERO = Timestamp()

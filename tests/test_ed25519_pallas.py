@@ -1,0 +1,103 @@
+"""Differential tests: Pallas fused ed25519 kernel vs oracle and XLA kernel.
+
+Runs in Pallas interpret mode on the CPU test mesh (conftest forces
+JAX_PLATFORMS=cpu); the same code path compiles to Mosaic on real TPU.
+Covers the identical case matrix as tests/test_ed25519_kernel.py —
+valid batches, the blame path, garbage inputs, and the ZIP-215 edge cases
+whose CPU/TPU divergence would fork consensus.
+"""
+import numpy as np
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_kernel as k
+from cometbft_tpu.ops import ed25519_pallas as kp
+
+
+def make_sigs(n, msg_fn=lambda i: b"msg-%d" % i):
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pubs = [ed.pubkey_from_seed(s) for s in seeds]
+    msgs = [msg_fn(i) for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+    return pubs, msgs, sigs
+
+
+def test_all_valid_batch():
+    pubs, msgs, sigs = make_sigs(5)
+    got = kp.verify_batch(pubs, msgs, sigs)
+    assert got.shape == (5,)
+    assert got.all()
+
+
+def test_blame_path_mixed_batch():
+    pubs, msgs, sigs = make_sigs(8)
+    bad = dict()
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    bad[2] = True
+    msgs[5] = msgs[5] + b"tampered"
+    bad[5] = True
+    sigs[6] = sigs[6][:32] + int.to_bytes(
+        int.from_bytes(sigs[6][32:], "little") + ed.L, 32, "little"
+    )  # S >= L: malleability reject in precheck
+    bad[6] = True
+    got = kp.verify_batch(pubs, msgs, sigs)
+    for i in range(8):
+        assert got[i] == (i not in bad), i
+        assert got[i] == ed.verify(pubs[i], msgs[i], sigs[i]), i
+
+
+def test_matches_oracle_on_garbage():
+    rng = np.random.default_rng(3)
+    pubs, msgs, sigs = [], [], []
+    for i in range(16):
+        pubs.append(rng.bytes(32))
+        msgs.append(rng.bytes(i))
+        sigs.append(rng.bytes(64))
+    got = kp.verify_batch(pubs, msgs, sigs)
+    exp = [ed.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+
+
+def test_zip215_edges():
+    """Must match the oracle bit-for-bit on non-canonical encodings and
+    small-order points — consensus forks otherwise."""
+    ident = ed.pt_compress(ed.IDENT)
+    cases = [(ident, b"m", ident + b"\x00" * 32)]
+    for y in range(19):
+        u, v = (y * y - 1) % ed.P, (ed.D * y * y + 1) % ed.P
+        ok, x = ed._sqrt_ratio(u, v)
+        if ok:
+            enc_nc = int.to_bytes((y + ed.P) | ((x & 1) << 255), 32, "little")
+            break
+    seed = bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    sig = ed.sign(seed, b"x")
+    cases.append((pub, b"x", enc_nc + sig[32:]))
+    cases.append((enc_nc, b"x", sig))
+    neg_zero = int.to_bytes(1 | (1 << 255), 32, "little")
+    cases.append((neg_zero, b"m", neg_zero + b"\x00" * 32))
+    pubs, msgs, sigs = zip(*cases)
+    got = kp.verify_batch(list(pubs), list(msgs), list(sigs))
+    exp = [ed.verify(p, m, s) for p, m, s in cases]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert any(exp)
+
+
+def test_matches_xla_kernel_cross_tile():
+    """Pallas and XLA kernels agree on a batch spanning >1 tile (B=256)."""
+    pubs, msgs, sigs = make_sigs(140)
+    # corrupt a few spread across both tiles
+    for i in (0, 63, 64, 127, 128, 139):
+        sigs[i] = sigs[i][:8] + bytes([sigs[i][8] ^ 2]) + sigs[i][9:]
+    got_p = kp.verify_batch(pubs, msgs, sigs)
+    got_x = k.verify_batch(pubs, msgs, sigs)
+    np.testing.assert_array_equal(got_p, got_x)
+    exp = np.ones(140, bool)
+    exp[[0, 63, 64, 127, 128, 139]] = False
+    np.testing.assert_array_equal(got_p, exp)
+
+
+def test_pad_to_tile():
+    assert kp.pad_to_tile(1) == 128
+    assert kp.pad_to_tile(64) == 128
+    assert kp.pad_to_tile(129) == 256
+    assert kp.pad_to_tile(257) == 1024

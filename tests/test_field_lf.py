@@ -1,0 +1,111 @@
+"""Differential tests: limbs-first (Pallas-dialect) field vs Python big ints.
+
+Mirrors tests/test_field.py but in the (NLIMBS, B) transposed layout used
+inside Pallas kernels (ops.field_lf).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cometbft_tpu.ops.field import F25519, FSECP, NLIMBS, limbs_to_int
+from cometbft_tpu.ops.field_lf import FieldLF, const_col
+
+RNG = np.random.default_rng(11)
+LF25519 = FieldLF(F25519)
+LFSECP = FieldLF(FSECP)
+FIELDS = [LF25519, LFSECP]
+
+
+def rand_elems(lf, n):
+    vals = [int.from_bytes(RNG.bytes(40), "little") % lf.p for _ in range(n)]
+    limbs = np.stack([lf.f.from_int(v) for v in vals], axis=1)  # (NLIMBS, n)
+    return vals, jnp.asarray(limbs)
+
+
+def check(lf, got_cols, expect_ints):
+    got = limbs_to_int(np.asarray(got_cols).T)
+    got = np.asarray([g % lf.p for g in got])
+    exp = np.asarray([e % lf.p for e in expect_ints])
+    np.testing.assert_array_equal(got, exp)
+
+
+@pytest.mark.parametrize("lf", FIELDS, ids=["ed25519", "secp256k1"])
+def test_add_sub_mul(lf):
+    a_int, a = rand_elems(lf, 32)
+    b_int, b = rand_elems(lf, 32)
+    check(lf, lf.add(a, b), [x + y for x, y in zip(a_int, b_int)])
+    check(lf, lf.sub(a, b), [x - y for x, y in zip(a_int, b_int)])
+    check(lf, lf.mul(a, b), [x * y for x, y in zip(a_int, b_int)])
+    check(lf, lf.square(a), [x * x for x in a_int])
+    check(lf, lf.neg(a), [-x for x in a_int])
+    check(lf, lf.mul_small(a, 121666), [x * 121666 for x in a_int])
+
+
+@pytest.mark.parametrize("lf", FIELDS, ids=["ed25519", "secp256k1"])
+def test_deep_chain_no_canonical(lf):
+    """Stress the lazy-limb invariant transposed: 50-op chains."""
+    a_int, a = rand_elems(lf, 8)
+    b_int, b = rand_elems(lf, 8)
+    x, xi = a, list(a_int)
+    for i in range(50):
+        if i % 3 == 0:
+            x, xi = lf.mul(x, b), [u * v for u, v in zip(xi, b_int)]
+        elif i % 3 == 1:
+            x, xi = lf.sub(lf.add(x, x), b), [2 * u - v for u, v in zip(xi, b_int)]
+        else:
+            x, xi = lf.square(x), [u * u for u in xi]
+        xi = [u % lf.p for u in xi]
+    check(lf, x, xi)
+    # fast mode admits the wider B1 invariant (field_lf.FieldLF.__init__)
+    assert int(np.abs(np.asarray(x)).max()) <= lf.bound1
+
+
+@pytest.mark.parametrize("lf", FIELDS, ids=["ed25519", "secp256k1"])
+def test_canonical_eq_parity(lf):
+    a_int, a = rand_elems(lf, 8)
+    canon = np.asarray(lf.canonical(lf.mul(a, a)))
+    assert (canon >= 0).all() and (canon < 2**13).all()
+    got = limbs_to_int(canon.T)
+    np.testing.assert_array_equal(
+        np.asarray([int(g) for g in got]),
+        np.asarray([v * v % lf.p for v in a_int]),
+    )
+    par = np.asarray(lf.parity(a))
+    assert par.shape == (1, 8)
+    np.testing.assert_array_equal(par[0], np.asarray([v & 1 for v in a_int]))
+    assert bool(np.all(np.asarray(lf.eq(a, a))))
+    z = lf.sub(a, a)
+    assert bool(np.all(np.asarray(lf.is_zero(z))))
+
+
+def test_pow_p58():
+    lf = LF25519
+    a_int, a = rand_elems(lf, 8)
+    got = limbs_to_int(np.asarray(lf.canonical(lf.pow_p58(a))).T)
+    exp = [pow(v, (lf.p - 5) // 8, lf.p) for v in a_int]
+    np.testing.assert_array_equal(
+        np.asarray([int(g) for g in got]), np.asarray(exp)
+    )
+
+
+def test_const_col_matches_from_int():
+    for lf in FIELDS:
+        for v in [0, 1, 19, lf.p - 1, 2**200 + 12345]:
+            t = lf.const_limbs(v)
+            col = np.asarray(const_col(t, 4))
+            expect = np.asarray(lf.f.from_int(v % lf.p))
+            for lane in range(4):
+                np.testing.assert_array_equal(col[:, lane], expect)
+
+
+def test_edge_values_zero_detect():
+    lf = LF25519
+    vals = [0, 1, lf.p - 1, (lf.p - 1) // 2, 2**255 - 20]
+    vals = [v % lf.p for v in vals]
+    limbs = jnp.asarray(np.stack([lf.f.from_int(v) for v in vals], axis=1))
+    one = const_col((1,) + (0,) * (NLIMBS - 1), len(vals))
+    zp = np.asarray(lf.is_zero(lf.add(limbs, one)))[0]
+    np.testing.assert_array_equal(
+        zp, np.asarray([v == lf.p - 1 for v in vals])
+    )

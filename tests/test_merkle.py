@@ -1,0 +1,56 @@
+"""Merkle tree differential tests against reference golden vectors.
+
+Vectors: crypto/merkle/tree_test.go:22-40 (HashFromByteSlices),
+rfc6962_test.go (leaf/inner/empty hashes).
+"""
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+
+GOLDEN = [
+    ([], "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"),
+    ([bytes([1, 2, 3])],
+     "054edec1d0211f624fed0cbca9d4f9400b0e491c43742af2c5b0abebf0c990d8"),
+    ([b""],
+     "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d"),
+    ([bytes([1, 2, 3]), bytes([4, 5, 6])],
+     "82e6cfce00453804379b53962939eaa7906b39904be0813fcadd31b100773c4b"),
+    ([bytes([1, 2]), bytes([3, 4]), bytes([5, 6]), bytes([7, 8]),
+      bytes([9, 10])],
+     "f326493eceab4f2d9ffbc78c59432a0a005d6ea98392045c74df5d14a113be18"),
+]
+
+
+@pytest.mark.parametrize("items,expect", GOLDEN)
+def test_hash_from_byte_slices_golden(items, expect):
+    assert merkle.hash_from_byte_slices(items).hex() == expect
+
+
+def test_rfc6962_primitives():
+    assert (merkle.leaf_hash(b"L123456").hex()
+            == "395aa064aa4c29f7010acfe3f25db9485bbd4b91897b6ad7ad547639252b4d56")
+    assert (merkle.inner_hash(b"N123", b"N456").hex()
+            == "aa217fe888e47007fa15edab33c2b492a722cb106c64667fc2b044444de66bbb")
+    assert merkle.empty_hash() == hashlib.sha256(b"").digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33, 100])
+def test_proofs_roundtrip(n):
+    items = [b"item-%d" % i for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, (item, p) in enumerate(zip(items, proofs)):
+        assert p.index == i and p.total == n
+        assert p.verify(root, item)
+        assert not p.verify(root, item + b"x")
+        bad_root = bytes([root[0] ^ 1]) + root[1:]
+        assert not p.verify(bad_root, item)
+
+
+def test_proof_wrong_position():
+    items = [b"a", b"b", b"c", b"d"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    # proof for index 0 must not verify item at index 1
+    assert not proofs[0].verify(root, items[1])

@@ -1,0 +1,102 @@
+"""ValidatorSet: sorting, lookup, proposer rotation, updates, hashing.
+
+Mirrors types/validator_set_test.go case structure (proposer rotation
+frequency proportional to power, update semantics, power cap).
+"""
+import pytest
+
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.types.validator import (
+    MAX_TOTAL_VOTING_POWER,
+    Validator,
+    ValidatorSet,
+    ValidatorSetError,
+)
+
+
+def mkvals(powers):
+    out = []
+    for i, p in enumerate(powers):
+        priv = PrivKey.generate(bytes([i + 1]) * 32)
+        out.append(Validator(priv.pub_key(), p))
+    return out
+
+
+def test_sorted_by_power_desc_then_address():
+    """ValidatorsByVotingPower order (validator_set.go:752-763): power
+    desc, address asc tiebreak — fixes the hash and index mapping."""
+    vs = ValidatorSet(mkvals([10, 30, 20, 30]))
+    powers = [v.voting_power for v in vs.validators]
+    assert powers == [30, 30, 20, 10]
+    tied = [v.address for v in vs.validators if v.voting_power == 30]
+    assert tied == sorted(tied)
+    for i, v in enumerate(vs.validators):
+        j, got = vs.get_by_address(v.address)
+        assert j == i and got is v
+    assert vs.get_by_address(b"\x00" * 20) == (-1, None)
+    assert vs.get_by_index(99) is None
+    assert vs.total_voting_power() == 90
+
+
+def test_duplicate_address_rejected():
+    v = mkvals([5])[0]
+    with pytest.raises(ValidatorSetError):
+        ValidatorSet([v, Validator(v.pub_key, 7)])
+
+
+def test_proposer_rotation_proportional():
+    """Proposer frequency tracks voting power (validator_set.go docstring:
+    priority-queue rotation)."""
+    vs = ValidatorSet(mkvals([1, 2, 7]))
+    by_addr = {v.address: 0 for v in vs.validators}
+    power = {v.address: v.voting_power for v in vs.validators}
+    for _ in range(1000):
+        p = vs.get_proposer()
+        by_addr[p.address] += 1
+        vs.increment_proposer_priority(1)
+    for a, count in by_addr.items():
+        assert abs(count - 100 * power[a]) <= 10, (count, power[a])
+
+
+def test_total_power_cap():
+    with pytest.raises(ValidatorSetError):
+        ValidatorSet(mkvals([MAX_TOTAL_VOTING_POWER, 1]))
+
+
+def test_hash_changes_with_set():
+    a = ValidatorSet(mkvals([10, 20]))
+    b = ValidatorSet(mkvals([10, 21]))
+    assert a.hash() != b.hash()
+    assert a.hash() == ValidatorSet(mkvals([10, 20])).hash()
+    assert len(a.hash()) == 32
+
+
+def test_update_with_change_set():
+    vals = mkvals([10, 20, 30])
+    vs = ValidatorSet(vals)
+    h0 = vs.hash()
+    # update power of one, remove one, add one
+    newv = mkvals([1, 1, 1, 40])[3]
+    changes = [
+        Validator(vals[0].pub_key, 15),   # update
+        Validator(vals[1].pub_key, 0),    # remove
+        newv,                              # add
+    ]
+    vs.update_with_change_set(changes)
+    assert vs.total_voting_power() == 15 + 30 + 40
+    assert not vs.has_address(vals[1].address)
+    assert vs.has_address(newv.address)
+    assert vs.hash() != h0
+    # removing a non-member fails
+    ghost = mkvals([1, 1, 1, 1, 9])[4]
+    with pytest.raises(ValidatorSetError):
+        vs.update_with_change_set([Validator(ghost.pub_key, 0)])
+
+
+def test_copy_isolated():
+    vs = ValidatorSet(mkvals([5, 5]))
+    cp = vs.copy()
+    before = [v.proposer_priority for v in cp.validators]
+    vs.increment_proposer_priority(3)
+    assert [v.proposer_priority for v in cp.validators] == before
+    assert [v.proposer_priority for v in vs.validators] != before
