@@ -1,0 +1,125 @@
+"""kvstore: the canonical test application.
+
+Reference: abci/example/kvstore/kvstore.go — key=value txs, deterministic
+app hash over state, validator-update txs of the form
+"val:base64pubkey!power" (kvstore.go:46 ValidatorSetChangePrefix).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from cometbft_tpu.abci import types as abci
+
+VALIDATOR_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.Application):
+    """In-memory kvstore with deterministic app hash and validator updates."""
+
+    def __init__(self):
+        self.state: Dict[bytes, bytes] = {}
+        self.height = 0
+        self.app_hash = b""
+        self.staged: Dict[bytes, bytes] = {}
+        self.val_updates: List[abci.ValidatorUpdate] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _compute_app_hash(self, height: int) -> bytes:
+        items = sorted(self.state.items())
+        h = hashlib.sha256()
+        h.update(height.to_bytes(8, "big"))
+        for k, v in items:
+            h.update(len(k).to_bytes(4, "big"))
+            h.update(k)
+            h.update(len(v).to_bytes(4, "big"))
+            h.update(v)
+        return h.digest()
+
+    @staticmethod
+    def _parse_val_tx(tx: bytes):
+        """val:base64pubkey!power -> (pubkey bytes, power) or None."""
+        if not tx.startswith(VALIDATOR_PREFIX):
+            return None
+        try:
+            body = tx[len(VALIDATOR_PREFIX):].decode()
+            b64, power = body.split("!", 1)
+            return base64.b64decode(b64), int(power)
+        except Exception:
+            raise ValueError(f"malformed validator tx: {tx!r}")
+
+    # -- ABCI ----------------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": len(self.state)}),
+            version="kvstore-tpu-0.1",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return abci.ResponseInitChain(app_hash=self._compute_app_hash(0))
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        tx = req.tx
+        if tx.startswith(VALIDATOR_PREFIX):
+            try:
+                self._parse_val_tx(tx)
+            except ValueError as e:
+                return abci.ResponseCheckTx(code=1, log=str(e))
+            return abci.ResponseCheckTx()
+        # key=value or bare bytes (key == value), kvstore.go:116
+        return abci.ResponseCheckTx()
+
+    def finalize_block(
+        self, req: abci.RequestFinalizeBlock
+    ) -> abci.ResponseFinalizeBlock:
+        self.staged = dict(self.state)
+        self.val_updates = []
+        results = []
+        for tx in req.txs:
+            val = self._parse_val_tx(tx) if tx.startswith(VALIDATOR_PREFIX) \
+                else None
+            if val is not None:
+                pub, power = val
+                self.val_updates.append(abci.ValidatorUpdate(pub, power))
+                results.append(abci.ExecTxResult())
+                continue
+            if b"=" in tx:
+                k, v = tx.split(b"=", 1)
+            else:
+                k = v = tx
+            self.staged[k] = v
+            results.append(abci.ExecTxResult(data=v))
+        self._pending_height = req.height
+        self._pending_hash = self._computed_staged_hash(req.height)
+        return abci.ResponseFinalizeBlock(
+            tx_results=results,
+            validator_updates=list(self.val_updates),
+            app_hash=self._pending_hash,
+        )
+
+    def _computed_staged_hash(self, height: int) -> bytes:
+        saved, self.state = self.state, self.staged
+        try:
+            return self._compute_app_hash(height)
+        finally:
+            self.state = saved
+
+    def commit(self) -> abci.ResponseCommit:
+        self.state = self.staged
+        self.height = self._pending_height
+        self.app_hash = self._pending_hash
+        return abci.ResponseCommit()
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        v = self.state.get(req.data, b"")
+        return abci.ResponseQuery(
+            key=req.data, value=v, height=self.height,
+            log="exists" if v else "does not exist",
+        )

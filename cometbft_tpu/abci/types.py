@@ -1,0 +1,205 @@
+"""ABCI: the application boundary interface and message types.
+
+Reference: abci/types/application.go:9-60 (the 14-method Application
+interface), proto/tendermint/abci (message fields — represented here as
+dataclasses; the socket/grpc wire codecs serialize them when the app runs
+out of process).
+
+The in-process path (proxy.local_client analog) passes these dataclasses
+directly — no serialization, mirroring abci/client/local_client.go.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+CODE_TYPE_OK = 0
+
+
+@dataclass
+class ValidatorUpdate:
+    pub_key: bytes  # raw ed25519 key bytes
+    power: int
+    key_type: str = "ed25519"
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class RequestInitChain:
+    time_seconds: int = 0
+    chain_id: str = ""
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+    initial_height: int = 1
+
+
+@dataclass
+class ResponseInitChain:
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+    app_hash: bytes = b""
+
+
+@dataclass
+class RequestCheckTx:
+    tx: bytes = b""
+    recheck: bool = False
+
+
+@dataclass
+class ResponseCheckTx:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+
+
+@dataclass
+class RequestPrepareProposal:
+    max_tx_bytes: int = 0
+    txs: List[bytes] = field(default_factory=list)
+    height: int = 0
+    proposer_address: bytes = b""
+
+
+@dataclass
+class ResponsePrepareProposal:
+    txs: List[bytes] = field(default_factory=list)
+
+
+@dataclass
+class RequestProcessProposal:
+    txs: List[bytes] = field(default_factory=list)
+    hash: bytes = b""
+    height: int = 0
+    proposer_address: bytes = b""
+
+
+PROCESS_PROPOSAL_ACCEPT = 1
+PROCESS_PROPOSAL_REJECT = 2
+
+
+@dataclass
+class ResponseProcessProposal:
+    status: int = PROCESS_PROPOSAL_ACCEPT
+
+
+@dataclass
+class RequestFinalizeBlock:
+    txs: List[bytes] = field(default_factory=list)
+    hash: bytes = b""
+    height: int = 0
+    proposer_address: bytes = b""
+    time_seconds: int = 0
+
+
+@dataclass
+class ExecTxResult:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+
+
+@dataclass
+class ResponseFinalizeBlock:
+    tx_results: List[ExecTxResult] = field(default_factory=list)
+    validator_updates: List[ValidatorUpdate] = field(default_factory=list)
+    app_hash: bytes = b""
+
+
+@dataclass
+class ResponseCommit:
+    retain_height: int = 0
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class ResponseQuery:
+    code: int = CODE_TYPE_OK
+    key: bytes = b""
+    value: bytes = b""
+    height: int = 0
+    log: str = ""
+
+
+class Application:
+    """The 14-method ABCI++ surface (abci/types/application.go:9-60).
+
+    Base implementations are accept-everything no-ops, mirroring
+    abci/types/application.go BaseApplication."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
+        return ResponseCheckTx()
+
+    def prepare_proposal(
+        self, req: RequestPrepareProposal
+    ) -> ResponsePrepareProposal:
+        return ResponsePrepareProposal(txs=list(req.txs))
+
+    def process_proposal(
+        self, req: RequestProcessProposal
+    ) -> ResponseProcessProposal:
+        return ResponseProcessProposal()
+
+    def finalize_block(
+        self, req: RequestFinalizeBlock
+    ) -> ResponseFinalizeBlock:
+        return ResponseFinalizeBlock(
+            tx_results=[ExecTxResult() for _ in req.txs]
+        )
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery()
+
+    # vote extensions (stubs; wired when consensus supports extensions)
+    def extend_vote(self, height: int, round_: int) -> bytes:
+        return b""
+
+    def verify_vote_extension(self, height, round_, ext: bytes) -> bool:
+        return True
+
+    # state-sync snapshots (stubs until statesync lands)
+    def list_snapshots(self):
+        return []
+
+    def offer_snapshot(self, snapshot) -> bool:
+        return False
+
+    def load_snapshot_chunk(self, height, fmt, chunk) -> bytes:
+        return b""
+
+    def apply_snapshot_chunk(self, index, chunk, sender) -> bool:
+        return False

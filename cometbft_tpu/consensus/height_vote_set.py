@@ -1,0 +1,66 @@
+"""HeightVoteSet: all VoteSets (prevotes + precommits per round) for one
+height.
+
+Reference: consensus/types/height_vote_set.go:41-60 (round -> {prevotes,
+precommits}, lazy round creation, peer catchup rounds).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet
+
+
+class HeightVoteSet:
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.valset = valset
+        self._lock = threading.Lock()
+        self._rounds: Dict[int, Dict[int, VoteSet]] = {}
+        self.round = 0
+        self.set_round(0)
+
+    def set_round(self, round_: int) -> None:
+        """Ensure vote sets exist up to round_ (+ catchup slack)."""
+        with self._lock:
+            for r in range(round_ + 1):
+                if r not in self._rounds:
+                    self._rounds[r] = {
+                        canonical.PREVOTE_TYPE: VoteSet(
+                            self.chain_id, self.height, r,
+                            canonical.PREVOTE_TYPE, self.valset,
+                        ),
+                        canonical.PRECOMMIT_TYPE: VoteSet(
+                            self.chain_id, self.height, r,
+                            canonical.PRECOMMIT_TYPE, self.valset,
+                        ),
+                    }
+            self.round = max(self.round, round_)
+
+    def add_vote(self, vote: Vote, verify: bool = True) -> bool:
+        self.set_round(vote.round)
+        return self._rounds[vote.round][vote.vote_type].add_vote(
+            vote, verify=verify
+        )
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        self.set_round(round_)
+        return self._rounds[round_][canonical.PREVOTE_TYPE]
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        self.set_round(round_)
+        return self._rounds[round_][canonical.PRECOMMIT_TYPE]
+
+    def pol_info(self):
+        """Highest round with a prevote 2/3 majority (POLRound, POLBlockID)."""
+        with self._lock:
+            for r in sorted(self._rounds.keys(), reverse=True):
+                maj = self._rounds[r][canonical.PREVOTE_TYPE].two_thirds_majority()
+                if maj is not None:
+                    return r, maj
+        return -1, None

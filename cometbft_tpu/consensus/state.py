@@ -1,0 +1,598 @@
+"""The Tendermint consensus state machine.
+
+Reference: consensus/state.go — the single-threaded receiveRoutine
+(:774-862) consuming peer/internal/timeout queues with WAL-write-before-
+process (:820-828); step functions enterNewRound (:1042), enterPropose
+(:1129), defaultDoPrevote (:1360), enterPrevote (:1311), enterPrecommit
+(:1513), enterCommit (:1648), finalizeCommit (:1739); vote ingest
+tryAddVote/addVote (:2110,:2161); own votes via signAddVote (:2452);
+crash recovery catchupReplay (replay.go:94).
+
+Scope notes for this slice: proposals carry whole blocks (the PartSet
+gossip split arrives with the p2p layer); prevote locking uses the
+is-locked/matches-locked rule without POL-based unlocking (safe — can
+only affect liveness under byzantine proposers, never safety). Messages
+reach peers via a pluggable broadcast callback so the same machine runs
+single-node, multi-node-in-process (in-memory hub), or over a real
+transport.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from cometbft_tpu.consensus import wal as walmod
+from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
+from cometbft_tpu.consensus.ticker import (
+    ManualTicker,
+    TimeoutInfo,
+    TimeoutParams,
+    TimeoutTicker,
+)
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types import canonical, serde
+from cometbft_tpu.types.block import Block
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import Commit
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import ConflictingVoteError
+
+# RoundStep* (consensus/types/round_state.go:12-24)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+
+@dataclass
+class ProposalMsg:
+    proposal: Proposal
+    block: Block  # whole block rides with the proposal in this slice
+
+
+@dataclass
+class VoteMsg:
+    vote: Vote
+
+
+class ConsensusState(BaseService):
+    """One validator's consensus engine instance."""
+
+    def __init__(
+        self,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        privval=None,
+        wal_path: Optional[str] = None,
+        broadcast: Optional[Callable] = None,
+        manual_ticker: bool = False,
+        timeouts: Optional[TimeoutParams] = None,
+    ):
+        super().__init__("ConsensusState")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.privval = privval
+        self.broadcast = broadcast or (lambda msg: None)
+        self.timeouts = timeouts or TimeoutParams()
+
+        self.msg_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self.internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        ticker_cls = ManualTicker if manual_ticker else TimeoutTicker
+        self.ticker = ticker_cls(self._on_timeout)
+
+        self.wal = walmod.WAL(wal_path) if wal_path else None
+        self._wal_path = wal_path
+
+        # round state (consensus/types/round_state.go)
+        self.height = state.last_block_height + 1
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.proposal: Optional[Proposal] = None
+        self.proposal_block: Optional[Block] = None
+        self.locked_round = -1
+        self.locked_block: Optional[Block] = None
+        self.valid_round = -1
+        self.valid_block: Optional[Block] = None
+        self.votes = HeightVoteSet(state.chain_id, self.height,
+                                   state.validators)
+        self.commit_round = -1
+        self._decided = threading.Event()
+        self._height_waiters: List = []
+        self._thread: Optional[threading.Thread] = None
+
+        # test override hooks (state.go:122-125 decideProposal/doPrevote)
+        self.decide_proposal_fn = self._default_decide_proposal
+        self.do_prevote_fn = self._default_do_prevote
+
+    # ---------------------------------------------------------------------
+    # service lifecycle
+    # ---------------------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self._wal_path:
+            self._catchup_replay()
+        self._thread = threading.Thread(
+            target=self._receive_routine, daemon=True,
+            name=f"consensus-h{self.height}",
+        )
+        self._thread.start()
+        self._schedule_round0()
+
+    def on_stop(self) -> None:
+        self.ticker.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.wal:
+            self.wal.close()
+
+    def _schedule_round0(self) -> None:
+        self.internal_queue.put(("start_round", self.height, 0))
+
+    # ---------------------------------------------------------------------
+    # message intake
+    # ---------------------------------------------------------------------
+
+    def receive_proposal(self, msg: ProposalMsg) -> None:
+        self.msg_queue.put(("proposal", msg))
+
+    def receive_vote(self, vote: Vote) -> None:
+        self.msg_queue.put(("vote", VoteMsg(vote)))
+
+    def _on_timeout(self, ti: TimeoutInfo) -> None:
+        self.internal_queue.put(("timeout", ti))
+
+    # ---------------------------------------------------------------------
+    # the receive routine (state.go:774)
+    # ---------------------------------------------------------------------
+
+    def _receive_routine(self) -> None:
+        while self.is_running():
+            item = self._next_msg()
+            if item is None:
+                continue
+            try:
+                self._handle(item, write_wal=True)
+            except Exception:  # noqa: BLE001 - engine must not die silently
+                import traceback
+
+                traceback.print_exc()
+
+    def _next_msg(self, timeout: float = 0.1):
+        try:
+            return self.internal_queue.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            return self.msg_queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _handle(self, item, write_wal: bool) -> None:
+        kind = item[0]
+        if write_wal and self.wal:
+            self._wal_write(item)
+        if kind == "start_round":
+            _, h, r = item
+            if h == self.height:
+                self._enter_new_round(h, r)
+        elif kind == "proposal":
+            self._set_proposal(item[1])
+        elif kind == "vote":
+            self._try_add_vote(item[1].vote)
+        elif kind == "timeout":
+            self._handle_timeout(item[1])
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:934 handleTimeout."""
+        if ti.height != self.height or ti.round < self.round:
+            return
+        if ti.step == STEP_PROPOSE and self.step == STEP_PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT and self.step <= STEP_PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT \
+                and self.step <= STEP_PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        elif ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+
+    # ---------------------------------------------------------------------
+    # WAL
+    # ---------------------------------------------------------------------
+
+    def _wal_write(self, item) -> None:
+        kind = item[0]
+        if kind == "vote":
+            self.wal.write_sync(walmod.MSG_INFO, json.dumps(
+                {"t": "vote", "v": serde.vote_to_j(item[1].vote)}
+            ).encode())
+        elif kind == "proposal":
+            msg: ProposalMsg = item[1]
+            self.wal.write_sync(walmod.MSG_INFO, json.dumps({
+                "t": "proposal",
+                "p": {
+                    "height": msg.proposal.height,
+                    "round": msg.proposal.round,
+                    "pol_round": msg.proposal.pol_round,
+                    "block_id": serde.bid_to_j(msg.proposal.block_id),
+                    "ts": serde.ts_to_j(msg.proposal.timestamp),
+                    "sig": msg.proposal.signature.hex(),
+                },
+                "b": json.loads(serde.block_to_json(msg.block)),
+            }).encode())
+        elif kind == "timeout":
+            ti: TimeoutInfo = item[1]
+            self.wal.write(walmod.TIMEOUT_INFO, struct.pack(
+                ">qii", ti.height, ti.round, ti.step
+            ))
+
+    def _catchup_replay(self) -> None:
+        """replay.go:94 catchupReplay: re-handle messages logged after the
+        last ENDHEIGHT(height-1)."""
+        path = self._wal_path
+        start = walmod.WAL.search_for_end_height(path, self.height - 1)
+        if start is None:
+            return
+        for i, rec in enumerate(walmod.WAL.iter_records(path)):
+            if i < start or rec.kind != walmod.MSG_INFO:
+                continue
+            j = json.loads(rec.data.decode())
+            if j["t"] == "vote":
+                vote = serde.vote_from_j(j["v"])
+                if vote.height == self.height:
+                    self._try_add_vote(vote, from_replay=True)
+            elif j["t"] == "proposal":
+                p = j["p"]
+                prop = Proposal(
+                    p["height"], p["round"], p["pol_round"],
+                    serde.bid_from_j(p["block_id"]),
+                    serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
+                )
+                block = serde.block_from_json(json.dumps(j["b"]))
+                if prop.height == self.height:
+                    self._set_proposal(
+                        ProposalMsg(prop, block), from_replay=True
+                    )
+
+    # ---------------------------------------------------------------------
+    # step: new round / propose
+    # ---------------------------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:1042: skip unless (height, round) advances us."""
+        if height != self.height:
+            return
+        if round_ < self.round:
+            return
+        if round_ == self.round and self.step != STEP_NEW_HEIGHT:
+            return
+        # per-round proposer: a COPY of the height's validator set with
+        # `round` extra priority increments (state.go:1058-1062) — the
+        # canonical state.validators is never mutated mid-height
+        if round_ == 0:
+            self.round_validators = self.state.validators
+        else:
+            rv = self.state.validators.copy()
+            rv.increment_proposer_priority(round_)
+            self.round_validators = rv
+        self.round = round_
+        self.step = STEP_NEW_ROUND
+        if round_ > 0:
+            self.proposal = None
+            self.proposal_block = None
+        self.votes.set_round(round_)
+        self._enter_propose(height, round_)
+
+    def _proposer(self):
+        vs = getattr(self, "round_validators", None) or self.state.validators
+        return vs.get_proposer()
+
+    def is_proposer(self) -> bool:
+        if self.privval is None:
+            return False
+        return (
+            self._proposer().address == self.privval.pub_key().address()
+        )
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """state.go:1129."""
+        self.step = STEP_PROPOSE
+        self.ticker.schedule(TimeoutInfo(
+            height, round_, STEP_PROPOSE,
+            self.timeouts.propose_timeout(round_),
+        ))
+        if self.is_proposer():
+            self.decide_proposal_fn(height, round_)
+        # a complete proposal may already be present (replay / gossip race)
+        if self._proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1180 defaultDecideProposal."""
+        if self.valid_block is not None:
+            block = self.valid_block
+        else:
+            block = self.block_exec.create_proposal_block(
+                height, self.state,
+                self._load_last_commit(height),
+                self.privval.pub_key().address(),
+            )
+        bid = BlockID(block.hash(), PartSetHeader(1, block.hash()))
+        prop = Proposal(height, round_, self.valid_round, bid,
+                        Timestamp.now())
+        prop.signature = self.privval.sign_proposal(
+            self.state.chain_id, height, round_, prop.pol_round, bid,
+            prop.timestamp,
+        )
+        msg = ProposalMsg(prop, block)
+        self.internal_queue.put(("proposal", msg))
+        self.broadcast(("proposal", msg))
+
+    def _load_last_commit(self, height: int) -> Optional[Commit]:
+        if height == self.state.initial_height:
+            return Commit(height - 1, 0, BlockID(), [])
+        return self.block_store.load_seen_commit(height - 1)
+
+    def _proposal_complete(self) -> bool:
+        return self.proposal is not None and self.proposal_block is not None
+
+    def _set_proposal(self, msg: ProposalMsg, from_replay: bool = False) \
+            -> None:
+        """state.go:1890 defaultSetProposal + addProposalBlockPart."""
+        if self.proposal is not None:
+            return
+        p = msg.proposal
+        if p.height != self.height or p.round != self.round:
+            return
+        p.validate_basic()
+        proposer = self._proposer()
+        if not from_replay and not p.verify(
+            self.state.chain_id, proposer.pub_key
+        ):
+            raise ValueError("invalid proposal signature")
+        if msg.block.hash() != p.block_id.hash:
+            raise ValueError("proposal block hash mismatch")
+        self.proposal = p
+        self.proposal_block = msg.block
+        if self.step == STEP_PROPOSE and self._proposal_complete():
+            self._enter_prevote(self.height, self.round)
+        elif self.step >= STEP_COMMIT:
+            self._try_finalize_commit(self.height)
+
+    # ---------------------------------------------------------------------
+    # step: prevote / precommit
+    # ---------------------------------------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1311."""
+        if self.step >= STEP_PREVOTE:
+            return
+        self.step = STEP_PREVOTE
+        self.do_prevote_fn(height, round_)
+        self._check_vote_quorums()
+
+    def _default_do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1360 defaultDoPrevote."""
+        if self.locked_block is not None:
+            # prevote the locked block (POL-based unlocking arrives with
+            # full multi-round byzantine support)
+            if self.proposal_block is not None and \
+                    self.proposal_block.hash() == self.locked_block.hash():
+                self._sign_add_vote(canonical.PREVOTE_TYPE,
+                                    self._block_id(self.locked_block))
+            else:
+                self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
+            return
+        if self.proposal_block is None:
+            self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
+            return
+        try:
+            self.block_exec.validate_block(self.state, self.proposal_block)
+            ok = self.block_exec.process_proposal(
+                self.proposal_block, self.state
+            )
+        except Exception:
+            ok = False
+        self._sign_add_vote(
+            canonical.PREVOTE_TYPE,
+            self._block_id(self.proposal_block) if ok else BlockID(),
+        )
+
+    def _block_id(self, block: Block) -> BlockID:
+        return BlockID(block.hash(), PartSetHeader(1, block.hash()))
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        if self.step >= STEP_PREVOTE_WAIT:
+            return
+        self.step = STEP_PREVOTE_WAIT
+        self.ticker.schedule(TimeoutInfo(
+            height, round_, STEP_PREVOTE_WAIT,
+            self.timeouts.prevote_timeout(round_),
+        ))
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1513."""
+        if self.step >= STEP_PRECOMMIT:
+            return
+        self.step = STEP_PRECOMMIT
+        maj = self.votes.prevotes(round_).two_thirds_majority()
+        if maj is None:
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, BlockID())
+            return
+        if maj.is_nil():
+            # +2/3 prevoted nil: unlock (state.go:1570)
+            self.locked_round = -1
+            self.locked_block = None
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, BlockID())
+            return
+        if self.proposal_block is not None and \
+                self.proposal_block.hash() == maj.hash:
+            self.locked_round = round_
+            self.locked_block = self.proposal_block
+            self.valid_round = round_
+            self.valid_block = self.proposal_block
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, maj)
+            return
+        if self.locked_block is not None and \
+                self.locked_block.hash() == maj.hash:
+            self.locked_round = round_
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, maj)
+            return
+        # 2/3 for a block we don't have: precommit nil, remember valid
+        self.locked_round = -1
+        self.locked_block = None
+        self._sign_add_vote(canonical.PRECOMMIT_TYPE, BlockID())
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        self.ticker.schedule(TimeoutInfo(
+            height, round_, STEP_PRECOMMIT_WAIT,
+            self.timeouts.precommit_timeout(round_),
+        ))
+
+    # ---------------------------------------------------------------------
+    # votes
+    # ---------------------------------------------------------------------
+
+    def _sign_add_vote(self, vote_type: int, block_id: BlockID) -> None:
+        """state.go:2452 signAddVote."""
+        if self.privval is None:
+            return
+        addr = self.privval.pub_key().address()
+        idx, _ = self.state.validators.get_by_address(addr)
+        if idx < 0:
+            return
+        vote = Vote(
+            vote_type=vote_type,
+            height=self.height,
+            round=self.round,
+            block_id=block_id,
+            timestamp=Timestamp.now(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        vote.signature = self.privval.sign_vote(self.state.chain_id, vote)
+        # own votes ride the internal queue so they are WAL-logged before
+        # being processed (state.go:2452 signAddVote -> sendInternalMessage)
+        self.internal_queue.put(("vote", VoteMsg(vote)))
+        self.broadcast(("vote", vote))
+
+    def _try_add_vote(self, vote: Vote, from_replay: bool = False) -> None:
+        """state.go:2110 tryAddVote -> addVote (:2161)."""
+        if vote.height != self.height:
+            return
+        try:
+            added = self.votes.add_vote(vote, verify=True)
+        except ConflictingVoteError:
+            # evidence collection lands with the evidence pool
+            return
+        if added:
+            self._check_vote_quorums()
+
+    def _check_vote_quorums(self) -> None:
+        """Quorum-driven step transitions (state.go addVote tail)."""
+        r = self.round
+        prevotes = self.votes.prevotes(r)
+        if self.step == STEP_PREVOTE and prevotes.has_two_thirds_majority():
+            self._enter_precommit(self.height, r)
+        elif self.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT) and \
+                prevotes.has_two_thirds_any():
+            self._enter_prevote_wait(self.height, r)
+
+        precommits = self.votes.precommits(r)
+        maj = precommits.two_thirds_majority()
+        if maj is not None:
+            if maj.is_nil():
+                if self.step >= STEP_PRECOMMIT:
+                    self._enter_new_round(self.height, r + 1)
+            else:
+                self._enter_commit(self.height, r)
+        elif self.step == STEP_PRECOMMIT and precommits.has_two_thirds_any():
+            self._enter_precommit_wait(self.height, r)
+
+    # ---------------------------------------------------------------------
+    # step: commit / finalize
+    # ---------------------------------------------------------------------
+
+    def _enter_commit(self, height: int, round_: int) -> None:
+        """state.go:1648."""
+        if self.step >= STEP_COMMIT:
+            return
+        self.step = STEP_COMMIT
+        self.commit_round = round_
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """state.go:1709."""
+        maj = self.votes.precommits(self.commit_round).two_thirds_majority()
+        if maj is None or maj.is_nil():
+            return
+        block = self.proposal_block
+        if block is None or block.hash() != maj.hash:
+            # wait for the block to arrive via gossip
+            return
+        self._finalize_commit(height, maj, block)
+
+    def _finalize_commit(self, height: int, block_id: BlockID,
+                         block: Block) -> None:
+        """state.go:1739: persist, apply through ABCI, move to next height."""
+        seen_commit = self.votes.precommits(self.commit_round).make_commit()
+        self.block_store.save_block(block, seen_commit)
+        if self.wal:
+            self.wal.write_end_height(height)
+        new_state = self.block_exec.apply_block(
+            self.state, block_id, block
+        )
+        self.state = new_state
+        self._decided.set()
+        self._advance_to_height(new_state)
+
+    def _advance_to_height(self, new_state: State) -> None:
+        """updateToState (state.go:2005) + scheduleRound0."""
+        self.height = new_state.last_block_height + 1
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.proposal = None
+        self.proposal_block = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.votes = HeightVoteSet(
+            new_state.chain_id, self.height, new_state.validators
+        )
+        self.round_validators = new_state.validators
+        self.commit_round = -1
+        self.ticker.schedule(TimeoutInfo(
+            self.height, 0, STEP_NEW_HEIGHT, self.timeouts.commit,
+        ))
+
+    # ---------------------------------------------------------------------
+    # test / observer helpers
+    # ---------------------------------------------------------------------
+
+    def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
+        """Block until the chain reaches `height` (tests/drivers)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.state.last_block_height >= height:
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,74 @@
+"""Mempool: CheckTx-gated tx queue with cache and post-block update.
+
+Reference: mempool/clist_mempool.go:26 (CListMempool) — CheckTx via ABCI
+with an LRU dedup cache (:117), ReapMaxBytesMaxGas (:519), post-block
+Update + recheck (:577). The concurrent-linked-list machinery exists for
+lock-free gossip iteration; a deque + lock provides the same semantics
+for the in-process build (the p2p reactor iterates snapshots).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import List, Optional
+
+from cometbft_tpu.abci import types as abci
+
+CACHE_SIZE = 10000  # config.mempool.cache_size default
+
+
+class Mempool:
+    def __init__(self, app: abci.Application, max_txs: int = 5000):
+        self.app = app
+        self.max_txs = max_txs
+        self._txs: deque = deque()
+        self._tx_set = set()
+        self._cache: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        """CheckTx + add (clist_mempool.go:117)."""
+        with self._lock:
+            if tx in self._cache:
+                return abci.ResponseCheckTx(code=1, log="tx already in cache")
+            self._cache[tx] = None
+            if len(self._cache) > CACHE_SIZE:
+                self._cache.popitem(last=False)
+        resp = self.app.check_tx(abci.RequestCheckTx(tx=tx))
+        if resp.code == abci.CODE_TYPE_OK:
+            with self._lock:
+                if len(self._txs) < self.max_txs and tx not in self._tx_set:
+                    self._txs.append(tx)
+                    self._tx_set.add(tx)
+        return resp
+
+    def reap(self, max_bytes: int = -1, max_txs: int = -1) -> List[bytes]:
+        """ReapMaxBytesMaxGas (clist_mempool.go:519)."""
+        out, total = [], 0
+        with self._lock:
+            for tx in self._txs:
+                if max_txs >= 0 and len(out) >= max_txs:
+                    break
+                if max_bytes >= 0 and total + len(tx) > max_bytes:
+                    break
+                out.append(tx)
+                total += len(tx)
+        return out
+
+    def update(self, height: int, committed: List[bytes]) -> None:
+        """Remove committed txs (clist_mempool.go:577 Update)."""
+        with self._lock:
+            committed_set = set(committed)
+            self._txs = deque(
+                t for t in self._txs if t not in committed_set
+            )
+            self._tx_set -= committed_set
+
+    def flush(self) -> None:
+        with self._lock:
+            self._txs.clear()
+            self._tx_set.clear()

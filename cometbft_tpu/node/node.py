@@ -1,0 +1,133 @@
+"""Node: wires stores, ABCI app, mempool, and consensus into one unit.
+
+Reference: node/node.go:263-525 NewNode (DBs -> stateStore -> proxyApp ->
+handshake -> mempool -> blockExec -> consensus -> ...), OnStart (:527).
+The p2p switch/reactors slot in where `broadcast` is today; an in-memory
+hub (LocalNetwork) plays the transport for multi-node-in-process tests
+(the p2p/test_util.go:315 analog).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types.validator import ValidatorSet
+
+
+class Node(BaseService):
+    def __init__(
+        self,
+        app: abci.Application,
+        genesis_state: State,
+        privval: Optional[FilePV] = None,
+        home: Optional[str] = None,
+        broadcast: Optional[Callable] = None,
+        timeouts: Optional[TimeoutParams] = None,
+        batch_fn: Optional[Callable] = None,
+    ):
+        super().__init__("Node")
+        self.app = app
+        self.home = home
+        db = lambda name: (
+            os.path.join(home, name) if home else ":memory:"
+        )
+        if home:
+            os.makedirs(home, exist_ok=True)
+        self.block_store = BlockStore(db("blockstore.db"))
+        self.state_store = StateStore(db("state.db"))
+
+        # handshake: adopt persisted state if it exists
+        # (consensus/replay.go:242 Handshaker)
+        persisted = self.state_store.load()
+        state = persisted if persisted is not None else genesis_state
+        if persisted is None:
+            ri = self.app.init_chain(abci.RequestInitChain(
+                chain_id=state.chain_id,
+                initial_height=state.initial_height,
+            ))
+            if ri.app_hash:
+                from dataclasses import replace
+
+                state = replace(state, app_hash=ri.app_hash)
+            self.state_store.save(state)
+        else:
+            # replay stored blocks the app hasn't seen
+            # (consensus/replay.go:285 ReplayBlocks)
+            info = self.app.info(abci.RequestInfo())
+            for h in range(
+                info.last_block_height + 1, state.last_block_height + 1
+            ):
+                blk = self.block_store.load_block(h)
+                if blk is None:
+                    raise RuntimeError(f"missing block {h} for app replay")
+                self.app.finalize_block(abci.RequestFinalizeBlock(
+                    txs=list(blk.data.txs), hash=blk.hash() or b"",
+                    height=h, proposer_address=blk.header.proposer_address,
+                    time_seconds=blk.header.time.seconds,
+                ))
+                self.app.commit()
+
+        self.mempool = Mempool(app)
+        self.block_exec = BlockExecutor(
+            app, self.state_store, batch_fn=batch_fn, mempool=self.mempool
+        )
+        self.consensus = ConsensusState(
+            state,
+            self.block_exec,
+            self.block_store,
+            privval=privval,
+            wal_path=os.path.join(home, "cs.wal") if home else None,
+            broadcast=broadcast,
+            timeouts=timeouts,
+        )
+
+    def on_start(self) -> None:
+        self.consensus.start()
+
+    def on_stop(self) -> None:
+        self.consensus.stop()
+        self.block_store.close()
+        self.state_store.close()
+
+    # convenience API (rpc/core analogs; the JSON-RPC server wraps these)
+    def broadcast_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        return self.mempool.check_tx(tx)
+
+    def height(self) -> int:
+        return self.consensus.state.last_block_height
+
+    def query(self, key: bytes) -> abci.ResponseQuery:
+        return self.app.query(abci.RequestQuery(data=key))
+
+
+class LocalNetwork:
+    """In-memory message hub for multi-node-in-one-process tests
+    (p2p/test_util.go:315 MakeConnectedSwitches analog)."""
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+
+    def broadcaster(self, exclude_idx: int) -> Callable:
+        def bcast(msg):
+            kind, payload = msg
+            for i, n in enumerate(self.nodes):
+                if i == exclude_idx:
+                    continue
+                if kind == "proposal":
+                    n.consensus.receive_proposal(payload)
+                elif kind == "vote":
+                    n.consensus.receive_vote(payload)
+
+        return bcast
+
+    def add(self, node: Node) -> None:
+        self.nodes.append(node)

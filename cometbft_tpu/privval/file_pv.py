@@ -1,0 +1,162 @@
+"""FilePV: file-backed validator signer with double-sign protection.
+
+Reference: privval/file.go:157 (FilePV = key file + state file),
+:75-100 (FilePVLastSignState: height/round/step + signbytes/signature
+memo), :308-370 (signVote/signProposal: refuse to regress HRS; re-serve
+the exact previous signature when only the timestamp differs).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cometbft_tpu.crypto.keys import PrivKey, PubKey
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.vote import Vote
+
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_STEP = {
+    canonical.PREVOTE_TYPE: STEP_PREVOTE,
+    canonical.PRECOMMIT_TYPE: STEP_PRECOMMIT,
+}
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+class FilePV:
+    """PrivValidator (types/priv_validator.go) backed by key+state files."""
+
+    def __init__(self, priv_key: PrivKey, key_path: Optional[str] = None,
+                 state_path: Optional[str] = None):
+        self.priv_key = priv_key
+        self.key_path = key_path
+        self.state_path = state_path
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.sign_bytes: Optional[bytes] = None
+        self.signature: Optional[bytes] = None
+        if state_path and os.path.exists(state_path):
+            self._load_state()
+
+    # -- persistence ---------------------------------------------------------
+
+    @staticmethod
+    def generate(dirpath: str, seed: Optional[bytes] = None) -> "FilePV":
+        os.makedirs(dirpath, exist_ok=True)
+        pv = FilePV(
+            PrivKey.generate(seed),
+            os.path.join(dirpath, "priv_validator_key.json"),
+            os.path.join(dirpath, "priv_validator_state.json"),
+        )
+        pv.save_key()
+        pv._save_state()
+        return pv
+
+    @staticmethod
+    def load(dirpath: str) -> "FilePV":
+        key_path = os.path.join(dirpath, "priv_validator_key.json")
+        with open(key_path) as f:
+            j = json.load(f)
+        return FilePV(
+            PrivKey(bytes.fromhex(j["priv_key"])),
+            key_path,
+            os.path.join(dirpath, "priv_validator_state.json"),
+        )
+
+    def save_key(self) -> None:
+        if not self.key_path:
+            return
+        with open(self.key_path, "w") as f:
+            json.dump({
+                "address": self.pub_key().address().hex(),
+                "pub_key": self.pub_key().data.hex(),
+                "priv_key": self.priv_key.data.hex(),
+            }, f)
+
+    def _save_state(self) -> None:
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "height": self.height,
+                "round": self.round,
+                "step": self.step,
+                "sign_bytes": (self.sign_bytes or b"").hex(),
+                "signature": (self.signature or b"").hex(),
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_path)
+
+    def _load_state(self) -> None:
+        with open(self.state_path) as f:
+            j = json.load(f)
+        self.height = j["height"]
+        self.round = j["round"]
+        self.step = j["step"]
+        self.sign_bytes = bytes.fromhex(j["sign_bytes"]) or None
+        self.signature = bytes.fromhex(j["signature"]) or None
+
+    # -- PrivValidator interface ----------------------------------------------
+
+    def pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+        """Sign a vote with HRS regression protection (file.go:308)."""
+        step = _VOTE_STEP[vote.vote_type]
+        self._check_hrs(vote.height, vote.round, step)
+        sb = vote.sign_bytes(chain_id)
+        # same HRS: only OK if sign bytes identical or only timestamp
+        # differs (file.go:330-346) — we require identical here; the
+        # consensus engine never re-signs with a new timestamp
+        if (self.height, self.round, self.step) == (
+            vote.height, vote.round, step
+        ):
+            if sb == self.sign_bytes:
+                return self.signature
+            raise DoubleSignError(
+                f"conflicting vote data at {vote.height}/{vote.round}/"
+                f"{step}"
+            )
+        sig = self.priv_key.sign(sb)
+        self.height, self.round, self.step = vote.height, vote.round, step
+        self.sign_bytes, self.signature = sb, sig
+        self._save_state()
+        return sig
+
+    def sign_proposal(self, chain_id: str, height: int, round_: int,
+                      pol_round: int, block_id, ts) -> bytes:
+        self._check_hrs(height, round_, STEP_PROPOSE)
+        sb = canonical.canonical_proposal_bytes(
+            chain_id, height, round_, pol_round, block_id, ts
+        )
+        if (self.height, self.round, self.step) == (
+            height, round_, STEP_PROPOSE
+        ):
+            if sb == self.sign_bytes:
+                return self.signature
+            raise DoubleSignError(
+                f"conflicting proposal data at {height}/{round_}"
+            )
+        sig = self.priv_key.sign(sb)
+        self.height, self.round, self.step = height, round_, STEP_PROPOSE
+        self.sign_bytes, self.signature = sb, sig
+        self._save_state()
+        return sig
+
+    def _check_hrs(self, h: int, r: int, s: int) -> None:
+        if (h, r, s) < (self.height, self.round, self.step):
+            raise DoubleSignError(
+                f"height regression: last signed "
+                f"{self.height}/{self.round}/{self.step}, asked {h}/{r}/{s}"
+            )

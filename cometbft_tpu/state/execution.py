@@ -1,0 +1,200 @@
+"""BlockExecutor: proposal creation, validation, and block application.
+
+Reference: state/execution.go — CreateProposalBlock (:109: mempool reap +
+PrepareProposal), ProcessProposal (:169), ApplyBlock (:211: FinalizeBlock
+-> validate updates -> save state -> Commit -> prune mempool),
+validateBlock / state/validation.go (header-vs-state checks :14-150 incl.
+the LastValidators.VerifyCommit full-power check :92).
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Callable, List, Optional, Tuple
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.keys import PubKey
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types import validation
+from cometbft_tpu.types.block import Block, Data, Header
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import Commit
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+
+class ExecutionError(Exception):
+    pass
+
+
+def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
+    """Merkle of deterministic ExecTxResult proto encodings
+    (abci/types/types.go TxResultsHash; only code/data/gas fields are
+    deterministic)."""
+    leaves = []
+    for r in tx_results:
+        body = pe.f_varint(1, r.code)
+        body += pe.f_bytes(2, r.data)
+        body += pe.f_varint(5, r.gas_wanted)
+        body += pe.f_varint(6, r.gas_used)
+        leaves.append(body)
+    return merkle.hash_from_byte_slices(leaves)
+
+
+class BlockExecutor:
+    """Drives blocks through the ABCI app and persists results.
+
+    The app connection is a direct Application reference (the in-process
+    local client, proxy/multi_app_conn.go's consensus conn analog).
+    """
+
+    def __init__(self, app: abci.Application, state_store,
+                 batch_fn: Optional[Callable] = None,
+                 mempool=None):
+        self.app = app
+        self.state_store = state_store
+        self.batch_fn = batch_fn
+        self.mempool = mempool
+
+    # -- proposal ------------------------------------------------------------
+
+    def create_proposal_block(
+        self, height: int, state: State, last_commit: Optional[Commit],
+        proposer_address: bytes, txs: Optional[List[bytes]] = None,
+        block_time: Optional[Timestamp] = None,
+    ) -> Block:
+        """execution.go:109 — reap txs, let the app reorder via
+        PrepareProposal, assemble the block."""
+        if txs is None:
+            txs = self.mempool.reap(state.consensus_params.block.max_bytes) \
+                if self.mempool else []
+        rpp = self.app.prepare_proposal(
+            abci.RequestPrepareProposal(
+                max_tx_bytes=state.consensus_params.block.max_bytes,
+                txs=list(txs), height=height,
+                proposer_address=proposer_address,
+            )
+        )
+        t = block_time or Timestamp.now()
+        header = Header(
+            chain_id=state.chain_id,
+            height=height,
+            time=t,
+            last_block_id=state.last_block_id,
+            validators_hash=state.validators.hash(),
+            next_validators_hash=state.next_validators.hash(),
+            consensus_hash=state.consensus_params.hash(),
+            app_hash=state.app_hash,
+            last_results_hash=state.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        block = Block(header, Data(list(rpp.txs)), last_commit)
+        block.fill_header()
+        return block
+
+    def process_proposal(self, block: Block, state: State) -> bool:
+        """execution.go:169 — ask the app to accept/reject."""
+        resp = self.app.process_proposal(
+            abci.RequestProcessProposal(
+                txs=list(block.data.txs), hash=block.hash() or b"",
+                height=block.header.height,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        return resp.status == abci.PROCESS_PROPOSAL_ACCEPT
+
+    # -- validation ----------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        """state/validation.go:14-150 header-vs-state checks."""
+        block.validate_basic()
+        h = block.header
+        if h.chain_id != state.chain_id:
+            raise ExecutionError("wrong chain id")
+        if h.height != state.last_block_height + 1:
+            raise ExecutionError(
+                f"wrong height {h.height}, expected "
+                f"{state.last_block_height + 1}"
+            )
+        if h.last_block_id != state.last_block_id:
+            raise ExecutionError("wrong LastBlockID")
+        if h.validators_hash != state.validators.hash():
+            raise ExecutionError("wrong Header.ValidatorsHash")
+        if h.next_validators_hash != state.next_validators.hash():
+            raise ExecutionError("wrong Header.NextValidatorsHash")
+        if h.app_hash != state.app_hash:
+            raise ExecutionError("wrong Header.AppHash")
+        if h.last_results_hash != state.last_results_hash:
+            raise ExecutionError("wrong Header.LastResultsHash")
+        if not state.validators.has_address(h.proposer_address):
+            raise ExecutionError("proposer not in validator set")
+        # full-power commit check against the set that signed it
+        # (state/validation.go:92)
+        if h.height > state.initial_height:
+            if block.last_commit is None:
+                raise ExecutionError("nil LastCommit")
+            validation.verify_commit(
+                state.chain_id, state.last_validators, state.last_block_id,
+                h.height - 1, block.last_commit, self.batch_fn,
+            )
+        elif block.last_commit and block.last_commit.signatures:
+            raise ExecutionError(
+                "initial block can't have LastCommit signatures"
+            )
+
+    # -- application ---------------------------------------------------------
+
+    def apply_block(
+        self, state: State, block_id: BlockID, block: Block,
+        validate: bool = True,
+    ) -> State:
+        """execution.go:211 ApplyBlock."""
+        if validate:
+            self.validate_block(state, block)
+        resp = self.app.finalize_block(
+            abci.RequestFinalizeBlock(
+                txs=list(block.data.txs), hash=block.hash() or b"",
+                height=block.header.height,
+                proposer_address=block.header.proposer_address,
+                time_seconds=block.header.time.seconds,
+            )
+        )
+        if len(resp.tx_results) != len(block.data.txs):
+            raise ExecutionError("app returned wrong number of tx results")
+
+        new_state = self._update_state(state, block_id, block, resp)
+        self.state_store.save(new_state)
+        self.app.commit()
+        if self.mempool:
+            self.mempool.update(block.header.height, block.data.txs)
+        return new_state
+
+    def _update_state(
+        self, state: State, block_id: BlockID, block: Block,
+        resp: abci.ResponseFinalizeBlock,
+    ) -> State:
+        """execution.go updateState (:560): rotate validator sets, apply
+        updates to next_validators (effective at H+2 — the +1 pipeline)."""
+        next_vals = state.next_validators.copy()
+        lhvc = state.last_height_validators_changed
+        if resp.validator_updates:
+            changes = [
+                Validator(PubKey(u.pub_key, u.key_type), u.power)
+                for u in resp.validator_updates
+            ]
+            next_vals.update_with_change_set(changes)
+            lhvc = block.header.height + 1 + 1
+        next_vals.increment_proposer_priority(1)
+        return replace(
+            state,
+            last_block_height=block.header.height,
+            last_block_id=block_id,
+            last_block_time=block.header.time,
+            last_validators=state.validators.copy(),
+            validators=state.next_validators.copy(),
+            next_validators=next_vals,
+            last_height_validators_changed=lhvc,
+            app_hash=resp.app_hash,
+            last_results_hash=results_hash(resp.tx_results),
+        )

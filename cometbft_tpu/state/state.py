@@ -1,0 +1,187 @@
+"""Chain state + persistent state store.
+
+Reference: state/state.go:355 (State: validators cur/next/last, params,
+last results), state/store.go (dbStore: save/load, validator-set history
+LoadValidators, bootstrap). sqlite3 stands in for cometbft-db.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from cometbft_tpu.crypto.keys import PubKey
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.params import ConsensusParams
+from cometbft_tpu.types.serde import (
+    bid_from_j,
+    bid_to_j,
+    ts_from_j,
+    ts_to_j,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+
+@dataclass
+class State:
+    """Immutable-ish snapshot of the replicated state machine's frame
+    (state/state.go:34-80). Copy-on-update via `replace`."""
+
+    chain_id: str
+    initial_height: int
+    last_block_height: int
+    last_block_id: BlockID
+    last_block_time: Timestamp
+    validators: ValidatorSet
+    next_validators: ValidatorSet
+    last_validators: Optional[ValidatorSet]
+    last_height_validators_changed: int
+    consensus_params: ConsensusParams
+    app_hash: bytes
+    last_results_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            validators=self.validators.copy(),
+            next_validators=self.next_validators.copy(),
+            last_validators=(
+                self.last_validators.copy() if self.last_validators else None
+            ),
+        )
+
+    @staticmethod
+    def make_genesis(
+        chain_id: str,
+        validators: ValidatorSet,
+        app_hash: bytes = b"",
+        initial_height: int = 1,
+        genesis_time: Optional[Timestamp] = None,
+    ) -> "State":
+        """MakeGenesisState (state/state.go:355)."""
+        return State(
+            chain_id=chain_id,
+            initial_height=initial_height,
+            last_block_height=0,
+            last_block_id=BlockID(),
+            last_block_time=genesis_time or Timestamp.now(),
+            validators=validators.copy(),
+            next_validators=validators.copy_increment_proposer_priority(1),
+            last_validators=None,
+            last_height_validators_changed=initial_height,
+            consensus_params=ConsensusParams(),
+            app_hash=app_hash,
+        )
+
+
+def _valset_to_j(vs: Optional[ValidatorSet]):
+    if vs is None:
+        return None
+    return [
+        {
+            "pub": v.pub_key.data.hex(),
+            "kt": v.pub_key.key_type,
+            "power": v.voting_power,
+            "prio": v.proposer_priority,
+        }
+        for v in vs.validators
+    ]
+
+
+def _valset_from_j(j) -> Optional[ValidatorSet]:
+    if j is None:
+        return None
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vals = [
+        Validator(
+            PubKey(bytes.fromhex(r["pub"]), r["kt"]), r["power"],
+            proposer_priority=r["prio"],
+        )
+        for r in j
+    ]
+    vs.validators = vals
+    vs._index = {v.address: i for i, v in enumerate(vals)}
+    vs._total_power = None
+    vs.proposer = None
+    return vs
+
+
+class StateStore:
+    """Persistent State + per-height validator sets (state/store.go)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._db:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS state (k TEXT PRIMARY KEY, "
+                "v TEXT)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS validators ("
+                "height INTEGER PRIMARY KEY, vals TEXT)"
+            )
+
+    def save(self, st: State) -> None:
+        doc = {
+            "chain_id": st.chain_id,
+            "initial_height": st.initial_height,
+            "last_block_height": st.last_block_height,
+            "last_block_id": bid_to_j(st.last_block_id),
+            "last_block_time": ts_to_j(st.last_block_time),
+            "validators": _valset_to_j(st.validators),
+            "next_validators": _valset_to_j(st.next_validators),
+            "last_validators": _valset_to_j(st.last_validators),
+            "lhvc": st.last_height_validators_changed,
+            "app_hash": st.app_hash.hex(),
+            "last_results_hash": st.last_results_hash.hex(),
+        }
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO state VALUES ('state', ?)",
+                (json.dumps(doc),),
+            )
+            # validator-set history: the set that signs height H
+            self._db.execute(
+                "INSERT OR REPLACE INTO validators VALUES (?, ?)",
+                (
+                    st.last_block_height + 1,
+                    json.dumps(_valset_to_j(st.validators)),
+                ),
+            )
+
+    def load(self) -> Optional[State]:
+        cur = self._db.execute("SELECT v FROM state WHERE k='state'")
+        row = cur.fetchone()
+        if not row:
+            return None
+        j = json.loads(row[0])
+        return State(
+            chain_id=j["chain_id"],
+            initial_height=j["initial_height"],
+            last_block_height=j["last_block_height"],
+            last_block_id=bid_from_j(j["last_block_id"]),
+            last_block_time=ts_from_j(j["last_block_time"]),
+            validators=_valset_from_j(j["validators"]),
+            next_validators=_valset_from_j(j["next_validators"]),
+            last_validators=_valset_from_j(j["last_validators"]),
+            last_height_validators_changed=j["lhvc"],
+            consensus_params=ConsensusParams(),
+            app_hash=bytes.fromhex(j["app_hash"]),
+            last_results_hash=bytes.fromhex(j["last_results_hash"]),
+        )
+
+    def load_validators(self, height: int) -> Optional[ValidatorSet]:
+        """The validator set responsible for signing `height`
+        (state/store.go LoadValidators)."""
+        cur = self._db.execute(
+            "SELECT vals FROM validators WHERE height=?", (height,)
+        )
+        row = cur.fetchone()
+        return _valset_from_j(json.loads(row[0])) if row else None
+
+    def close(self) -> None:
+        self._db.close()

@@ -1,0 +1,102 @@
+"""BlockStore: persisted blocks, commits and seen-commits by height.
+
+Reference: store/store.go:53 (BlockStore over cometbft-db), SaveBlock
+(:401), LoadBlock/LoadBlockCommit/LoadSeenCommit (:254-300), Base/Height
+bookkeeping, PruneBlocks (:301). sqlite3 (stdlib) plays the role of
+cometbft-db: single writer, transactional batch save.
+"""
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Optional
+
+from cometbft_tpu.types import serde
+from cometbft_tpu.types.block import Block
+from cometbft_tpu.types.commit import Commit
+
+
+class BlockStore:
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._db:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS blocks ("
+                "height INTEGER PRIMARY KEY, hash BLOB, block TEXT, "
+                "commit_json TEXT, seen_commit TEXT)"
+            )
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS blocks_hash ON blocks(hash)"
+            )
+
+    def base(self) -> int:
+        cur = self._db.execute("SELECT MIN(height) FROM blocks")
+        r = cur.fetchone()[0]
+        return r if r is not None else 0
+
+    def height(self) -> int:
+        cur = self._db.execute("SELECT MAX(height) FROM blocks")
+        r = cur.fetchone()[0]
+        return r if r is not None else 0
+
+    def save_block(self, block: Block, seen_commit: Commit) -> None:
+        """SaveBlock (store.go:401): block + its own SeenCommit; the
+        block's LastCommit rides inside the block."""
+        h = block.header.height
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO blocks VALUES (?,?,?,?,?)",
+                (
+                    h,
+                    block.hash(),
+                    serde.block_to_json(block),
+                    serde.json.dumps(serde.commit_to_j(block.last_commit)),
+                    serde.json.dumps(serde.commit_to_j(seen_commit)),
+                ),
+            )
+
+    def load_block(self, height: int) -> Optional[Block]:
+        cur = self._db.execute(
+            "SELECT block FROM blocks WHERE height=?", (height,)
+        )
+        row = cur.fetchone()
+        return serde.block_from_json(row[0]) if row else None
+
+    def load_block_by_hash(self, h: bytes) -> Optional[Block]:
+        cur = self._db.execute(
+            "SELECT block FROM blocks WHERE hash=?", (h,)
+        )
+        row = cur.fetchone()
+        return serde.block_from_json(row[0]) if row else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """The commit FOR block `height`, stored in block height+1's
+        LastCommit (store.go LoadBlockCommit loads it directly)."""
+        cur = self._db.execute(
+            "SELECT commit_json FROM blocks WHERE height=?", (height + 1,)
+        )
+        row = cur.fetchone()
+        if row:
+            return serde.commit_from_j(serde.json.loads(row[0]))
+        return self.load_seen_commit(height)
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        cur = self._db.execute(
+            "SELECT seen_commit FROM blocks WHERE height=?", (height,)
+        )
+        row = cur.fetchone()
+        return (
+            serde.commit_from_j(serde.json.loads(row[0])) if row else None
+        )
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Delete blocks below retain_height (store.go:301)."""
+        with self._lock, self._db:
+            cur = self._db.execute(
+                "DELETE FROM blocks WHERE height < ?", (retain_height,)
+            )
+            return cur.rowcount
+
+    def close(self) -> None:
+        self._db.close()

@@ -1,0 +1,44 @@
+"""Consensus parameters (minimal working subset).
+
+Reference: types/params.go (ConsensusParams, DefaultConsensusParams,
+HashConsensusParams :hash over proto HashedParams{BlockMaxBytes,
+BlockMaxGas}).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from cometbft_tpu.libs import protoenc as pe
+
+
+@dataclass
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB (params.go DefaultBlockParams)
+    max_gas: int = -1
+
+
+@dataclass
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration_ns: int = 48 * 3600 * 10**9
+    max_bytes: int = 1048576
+
+
+@dataclass
+class ValidatorParams:
+    pub_key_types: tuple = ("ed25519",)
+
+
+@dataclass
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+
+    def hash(self) -> bytes:
+        """SHA256 of proto HashedParams (params.go HashConsensusParams)."""
+        body = pe.f_varint(1, self.block.max_bytes) + pe.f_varint(
+            2, self.block.max_gas
+        )
+        return hashlib.sha256(body).digest()

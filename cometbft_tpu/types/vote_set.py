@@ -1,0 +1,239 @@
+"""VoteSet: thread-safe per-(height, round, type) vote accumulator.
+
+Reference: types/vote_set.go — AddVote (:157) -> validation -> signature
+verify (:216-231) -> addVerifiedVote (:257-328) with 2/3 quorum detection
+(:307-325), votesBitArray (:70), conflicting-vote tracking in votesByBlock
+(:74), peer maj23 claims (:335), MakeCommit/MakeExtendedCommit (:636).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    Commit,
+    CommitSig,
+)
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import MAX_VOTES_COUNT, Vote, VoteError
+
+
+class VoteSetError(Exception):
+    pass
+
+
+class ConflictingVoteError(VoteSetError):
+    def __init__(self, existing: Vote, new: Vote):
+        self.existing = existing
+        self.new = new
+        super().__init__("conflicting votes from validator")
+
+
+@dataclass
+class _BlockVotes:
+    """Votes for one particular block (vote_set.go blockVotes)."""
+
+    peer_maj23: bool
+    bit_array: BitArray
+    votes: List[Optional[Vote]]
+    sum: int = 0
+
+
+class VoteSet:
+    def __init__(self, chain_id: str, height: int, round_: int,
+                 signed_msg_type: int, valset: ValidatorSet):
+        if height == 0:
+            raise VoteSetError("cannot make VoteSet for height == 0")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.signed_msg_type = signed_msg_type
+        self.valset = valset
+        self._lock = threading.RLock()
+        n = len(valset)
+        self.votes_bit_array = BitArray(n)
+        self.votes: List[Optional[Vote]] = [None] * n
+        self.sum = 0
+        self.maj23: Optional[BlockID] = None
+        self.votes_by_block: Dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: Dict[str, BlockID] = {}
+
+    def size(self) -> int:
+        return len(self.valset)
+
+    # -- adding votes --------------------------------------------------------
+
+    def add_vote(self, vote: Optional[Vote], verify: bool = True) -> bool:
+        """AddVote (vote_set.go:157). Returns True if added. Raises
+        ConflictingVoteError on equivocation, VoteSetError/VoteError on
+        invalid votes."""
+        if vote is None:
+            raise VoteSetError("nil vote")
+        with self._lock:
+            return self._add_vote(vote, verify)
+
+    def _add_vote(self, vote: Vote, verify: bool) -> bool:
+        val_index = vote.validator_index
+        if val_index < 0:
+            raise VoteSetError("index < 0")
+        if not vote.signature:
+            raise VoteSetError("empty signature")
+        if (vote.height != self.height or vote.round != self.round
+                or vote.vote_type != self.signed_msg_type):
+            raise VoteSetError(
+                f"expected {self.height}/{self.round}/"
+                f"{self.signed_msg_type}, got {vote.height}/"
+                f"{vote.round}/{vote.vote_type}"
+            )
+        val = self.valset.get_by_index(val_index)
+        if val is None:
+            raise VoteSetError(f"no validator at index {val_index}")
+        if vote.validator_address != val.address:
+            raise VoteSetError("validator address/index mismatch")
+
+        existing = self.votes[val_index]
+        if existing is not None and existing.block_id == vote.block_id:
+            return False  # duplicate
+
+        if verify:
+            try:
+                vote.verify(self.chain_id, val.pub_key)
+            except VoteError as e:
+                raise VoteSetError(f"invalid vote: {e}") from e
+
+        return self._add_verified(vote, val.voting_power)
+
+    def _add_verified(self, vote: Vote, power: int) -> bool:
+        """addVerifiedVote (vote_set.go:257-328)."""
+        val_index = vote.validator_index
+        key = vote.block_id.key()
+        existing = self.votes[val_index]
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                return False
+            # equivocation: keep the first vote unless the new one is for
+            # a block with a peer-claimed maj23 (vote_set.go:281-302)
+            bv = self.votes_by_block.get(key)
+            if bv is None or not bv.peer_maj23:
+                raise ConflictingVoteError(existing, vote)
+            self.votes[val_index] = vote
+        else:
+            self.votes[val_index] = vote
+            self.votes_bit_array.set_index(val_index, True)
+            self.sum += power
+
+        bv = self.votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(
+                peer_maj23=False,
+                bit_array=BitArray(self.size()),
+                votes=[None] * self.size(),
+            )
+            self.votes_by_block[key] = bv
+        elif existing is not None and bv.votes[val_index] is not None:
+            return False  # already counted in this block's tally
+        bv.votes[val_index] = vote
+        bv.bit_array.set_index(val_index, True)
+        old_sum = bv.sum
+        bv.sum += power
+
+        # quorum detection (vote_set.go:307-325)
+        quorum = self.valset.total_voting_power() * 2 // 3 + 1
+        if old_sum < quorum <= bv.sum and self.maj23 is None:
+            self.maj23 = vote.block_id
+        return True
+
+    # -- queries -------------------------------------------------------------
+
+    def get_vote(self, val_index: int, block_key: bytes) -> Optional[Vote]:
+        with self._lock:
+            v = self.votes[val_index]
+            if v is not None and v.block_id.key() == block_key:
+                return v
+            bv = self.votes_by_block.get(block_key)
+            return bv.votes[val_index] if bv else None
+
+    def get_by_index(self, val_index: int) -> Optional[Vote]:
+        with self._lock:
+            return self.votes[val_index]
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        with self._lock:
+            return self.maj23
+
+    def has_two_thirds_majority(self) -> bool:
+        with self._lock:
+            return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        with self._lock:
+            return self.sum > self.valset.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        with self._lock:
+            return self.sum == self.valset.total_voting_power()
+
+    def bit_array(self) -> BitArray:
+        with self._lock:
+            return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> Optional[BitArray]:
+        with self._lock:
+            bv = self.votes_by_block.get(block_id.key())
+            return bv.bit_array.copy() if bv else None
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """SetPeerMaj23 (vote_set.go:335): a peer claims 2/3 for a block;
+        unlocks conflicting-vote acceptance for that block."""
+        with self._lock:
+            prev = self.peer_maj23s.get(peer_id)
+            if prev is not None:
+                if prev == block_id:
+                    return
+                raise VoteSetError("conflicting maj23 claim from peer")
+            self.peer_maj23s[peer_id] = block_id
+            key = block_id.key()
+            bv = self.votes_by_block.get(key)
+            if bv is None:
+                bv = _BlockVotes(
+                    peer_maj23=True,
+                    bit_array=BitArray(self.size()),
+                    votes=[None] * self.size(),
+                )
+                self.votes_by_block[key] = bv
+            else:
+                bv.peer_maj23 = True
+
+    # -- commit construction -------------------------------------------------
+
+    def make_commit(self) -> Commit:
+        """MakeExtendedCommit sans extensions (vote_set.go:636): requires
+        an established 2/3 majority on a non-nil block."""
+        with self._lock:
+            if self.signed_msg_type != 2:  # PRECOMMIT_TYPE
+                raise VoteSetError("cannot MakeCommit() unless precommits")
+            if self.maj23 is None or self.maj23.is_nil():
+                raise VoteSetError(
+                    "cannot MakeCommit() unless +2/3 committed a block"
+                )
+            sigs = []
+            for i, v in enumerate(self.votes):
+                if v is None:
+                    sigs.append(CommitSig.absent())
+                    continue
+                if v.block_id == self.maj23:
+                    flag = BLOCK_ID_FLAG_COMMIT
+                elif v.block_id.is_nil():
+                    flag = BLOCK_ID_FLAG_NIL
+                else:
+                    flag = BLOCK_ID_FLAG_NIL  # vote for other block
+                sigs.append(CommitSig(
+                    flag, v.validator_address, v.timestamp, v.signature,
+                ))
+            return Commit(self.height, self.round, self.maj23, sigs)
