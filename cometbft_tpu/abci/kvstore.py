@@ -76,6 +76,22 @@ class KVStoreApplication(abci.Application):
         # key=value or bare bytes (key == value), kvstore.go:116
         return abci.ResponseCheckTx()
 
+    def process_proposal(
+        self, req: abci.RequestProcessProposal
+    ) -> abci.ResponseProcessProposal:
+        """Reject blocks carrying malformed validator txs (the reference
+        kvstore validates in ProcessProposal so byzantine proposals never
+        reach FinalizeBlock)."""
+        for tx in req.txs:
+            if tx.startswith(VALIDATOR_PREFIX):
+                try:
+                    self._parse_val_tx(tx)
+                except ValueError:
+                    return abci.ResponseProcessProposal(
+                        status=abci.PROCESS_PROPOSAL_REJECT
+                    )
+        return abci.ResponseProcessProposal()
+
     def finalize_block(
         self, req: abci.RequestFinalizeBlock
     ) -> abci.ResponseFinalizeBlock:
@@ -83,10 +99,14 @@ class KVStoreApplication(abci.Application):
         self.val_updates = []
         results = []
         for tx in req.txs:
-            val = self._parse_val_tx(tx) if tx.startswith(VALIDATOR_PREFIX) \
-                else None
-            if val is not None:
-                pub, power = val
+            if tx.startswith(VALIDATOR_PREFIX):
+                # malformed val txs get a non-OK result; raising here would
+                # abort apply_block on every honest node and halt the chain
+                try:
+                    pub, power = self._parse_val_tx(tx)
+                except ValueError as e:
+                    results.append(abci.ExecTxResult(code=1, log=str(e)))
+                    continue
                 self.val_updates.append(abci.ValidatorUpdate(pub, power))
                 results.append(abci.ExecTxResult())
                 continue

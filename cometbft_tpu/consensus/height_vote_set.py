@@ -25,36 +25,50 @@ class HeightVoteSet:
         self.round = 0
         self.set_round(0)
 
+    def _ensure_round(self, round_: int) -> None:
+        """Allocate vote sets for round_ WITHOUT advancing self.round —
+        peer catch-up allocation must not ratchet the round bound."""
+        if round_ not in self._rounds:
+            self._rounds[round_] = {
+                canonical.PREVOTE_TYPE: VoteSet(
+                    self.chain_id, self.height, round_,
+                    canonical.PREVOTE_TYPE, self.valset,
+                ),
+                canonical.PRECOMMIT_TYPE: VoteSet(
+                    self.chain_id, self.height, round_,
+                    canonical.PRECOMMIT_TYPE, self.valset,
+                ),
+            }
+
     def set_round(self, round_: int) -> None:
-        """Ensure vote sets exist up to round_ (+ catchup slack)."""
+        """Advance the consensus round; only the engine entering a new
+        round moves the bound (height_vote_set.go:90 SetRound)."""
         with self._lock:
-            for r in range(round_ + 1):
-                if r not in self._rounds:
-                    self._rounds[r] = {
-                        canonical.PREVOTE_TYPE: VoteSet(
-                            self.chain_id, self.height, r,
-                            canonical.PREVOTE_TYPE, self.valset,
-                        ),
-                        canonical.PRECOMMIT_TYPE: VoteSet(
-                            self.chain_id, self.height, r,
-                            canonical.PRECOMMIT_TYPE, self.valset,
-                        ),
-                    }
+            for r in range(self.round, round_ + 2):
+                self._ensure_round(r)
             self.round = max(self.round, round_)
 
     def add_vote(self, vote: Vote, verify: bool = True) -> bool:
-        self.set_round(vote.round)
-        return self._rounds[vote.round][vote.vote_type].add_vote(
-            vote, verify=verify
-        )
+        # peers may be at most one round ahead of the CONSENSUS round
+        # (height_vote_set.go ErrGotVoteFromUnwantedRound); checked before
+        # any allocation, and add_vote never advances the bound — else a
+        # sequence of crafted future-round votes allocates without limit
+        with self._lock:
+            if vote.round > self.round + 1:
+                return False
+            self._ensure_round(vote.round)
+            vs = self._rounds[vote.round][vote.vote_type]
+        return vs.add_vote(vote, verify=verify)
 
     def prevotes(self, round_: int) -> Optional[VoteSet]:
-        self.set_round(round_)
-        return self._rounds[round_][canonical.PREVOTE_TYPE]
+        with self._lock:
+            self._ensure_round(round_)
+            return self._rounds[round_][canonical.PREVOTE_TYPE]
 
     def precommits(self, round_: int) -> Optional[VoteSet]:
-        self.set_round(round_)
-        return self._rounds[round_][canonical.PRECOMMIT_TYPE]
+        with self._lock:
+            self._ensure_round(round_)
+            return self._rounds[round_][canonical.PRECOMMIT_TYPE]
 
     def pol_info(self):
         """Highest round with a prevote 2/3 majority (POLRound, POLBlockID)."""

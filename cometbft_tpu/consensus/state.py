@@ -23,7 +23,7 @@ import queue
 import struct
 import threading
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from cometbft_tpu.consensus import wal as walmod
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
@@ -39,7 +39,7 @@ from cometbft_tpu.state.state import State
 from cometbft_tpu.store.blockstore import BlockStore
 from cometbft_tpu.types import canonical, serde
 from cometbft_tpu.types.block import Block
-from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.block_id import BlockID
 from cometbft_tpu.types.commit import Commit
 from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.timestamp import Timestamp
@@ -111,8 +111,7 @@ class ConsensusState(BaseService):
         self.votes = HeightVoteSet(state.chain_id, self.height,
                                    state.validators)
         self.commit_round = -1
-        self._decided = threading.Event()
-        self._height_waiters: List = []
+        self._triggered_precommit_wait = False
         self._thread: Optional[threading.Thread] = None
 
         # test override hooks (state.go:122-125 decideProposal/doPrevote)
@@ -252,23 +251,31 @@ class ConsensusState(BaseService):
         for i, rec in enumerate(walmod.WAL.iter_records(path)):
             if i < start or rec.kind != walmod.MSG_INFO:
                 continue
-            j = json.loads(rec.data.decode())
-            if j["t"] == "vote":
-                vote = serde.vote_from_j(j["v"])
-                if vote.height == self.height:
-                    self._try_add_vote(vote, from_replay=True)
-            elif j["t"] == "proposal":
-                p = j["p"]
-                prop = Proposal(
-                    p["height"], p["round"], p["pol_round"],
-                    serde.bid_from_j(p["block_id"]),
-                    serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
-                )
-                block = serde.block_from_json(json.dumps(j["b"]))
-                if prop.height == self.height:
-                    self._set_proposal(
-                        ProposalMsg(prop, block), from_replay=True
+            # messages are WAL-logged BEFORE validation (state.go:820), so
+            # a record the live path rejected must not brick the restart —
+            # log and continue like the reference's catchupReplay
+            try:
+                j = json.loads(rec.data.decode())
+                if j["t"] == "vote":
+                    vote = serde.vote_from_j(j["v"])
+                    if vote.height == self.height:
+                        self._try_add_vote(vote, from_replay=True)
+                elif j["t"] == "proposal":
+                    p = j["p"]
+                    prop = Proposal(
+                        p["height"], p["round"], p["pol_round"],
+                        serde.bid_from_j(p["block_id"]),
+                        serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
                     )
+                    block = serde.block_from_json(json.dumps(j["b"]))
+                    if prop.height == self.height:
+                        self._set_proposal(
+                            ProposalMsg(prop, block), from_replay=True
+                        )
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
 
     # ---------------------------------------------------------------------
     # step: new round / propose
@@ -293,6 +300,7 @@ class ConsensusState(BaseService):
             self.round_validators = rv
         self.round = round_
         self.step = STEP_NEW_ROUND
+        self._triggered_precommit_wait = False
         if round_ > 0:
             self.proposal = None
             self.proposal_block = None
@@ -333,7 +341,7 @@ class ConsensusState(BaseService):
                 self._load_last_commit(height),
                 self.privval.pub_key().address(),
             )
-        bid = BlockID(block.hash(), PartSetHeader(1, block.hash()))
+        bid = block.block_id()
         prop = Proposal(height, round_, self.valid_round, bid,
                         Timestamp.now())
         prop.signature = self.privval.sign_proposal(
@@ -395,7 +403,7 @@ class ConsensusState(BaseService):
             if self.proposal_block is not None and \
                     self.proposal_block.hash() == self.locked_block.hash():
                 self._sign_add_vote(canonical.PREVOTE_TYPE,
-                                    self._block_id(self.locked_block))
+                                    self.locked_block.block_id())
             else:
                 self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
             return
@@ -411,14 +419,11 @@ class ConsensusState(BaseService):
             ok = False
         self._sign_add_vote(
             canonical.PREVOTE_TYPE,
-            self._block_id(self.proposal_block) if ok else BlockID(),
+            self.proposal_block.block_id() if ok else BlockID(),
         )
 
-    def _block_id(self, block: Block) -> BlockID:
-        return BlockID(block.hash(), PartSetHeader(1, block.hash()))
-
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
-        if self.step >= STEP_PREVOTE_WAIT:
+        if round_ != self.round or self.step >= STEP_PREVOTE_WAIT:
             return
         self.step = STEP_PREVOTE_WAIT
         self.ticker.schedule(TimeoutInfo(
@@ -428,7 +433,10 @@ class ConsensusState(BaseService):
 
     def _enter_precommit(self, height: int, round_: int) -> None:
         """state.go:1513."""
-        if self.step >= STEP_PRECOMMIT:
+        # round guard (state.go:1515): a stale round's nil-precommit
+        # majority must not make us sign a precommit in the current round
+        # off the old round's prevotes
+        if round_ != self.round or self.step >= STEP_PRECOMMIT:
             return
         self.step = STEP_PRECOMMIT
         maj = self.votes.prevotes(round_).two_thirds_majority()
@@ -460,6 +468,14 @@ class ConsensusState(BaseService):
         self._sign_add_vote(canonical.PRECOMMIT_TYPE, BlockID())
 
     def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        # one-shot per round (state.go TriggeredTimeoutPrecommit): without
+        # the guard every straggling precommit restarts the timer,
+        # stretching stalled rounds indefinitely. The step is NOT advanced
+        # — precommit-wait can be triggered from any step once +2/3-any
+        # precommits exist for the round.
+        if round_ != self.round or self._triggered_precommit_wait:
+            return
+        self._triggered_precommit_wait = True
         self.ticker.schedule(TimeoutInfo(
             height, round_, STEP_PRECOMMIT_WAIT,
             self.timeouts.precommit_timeout(round_),
@@ -502,28 +518,42 @@ class ConsensusState(BaseService):
             # evidence collection lands with the evidence pool
             return
         if added:
-            self._check_vote_quorums()
+            self._check_vote_quorums(vote.round)
 
-    def _check_vote_quorums(self) -> None:
-        """Quorum-driven step transitions (state.go addVote tail)."""
-        r = self.round
-        prevotes = self.votes.prevotes(r)
-        if self.step == STEP_PREVOTE and prevotes.has_two_thirds_majority():
-            self._enter_precommit(self.height, r)
-        elif self.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT) and \
-                prevotes.has_two_thirds_any():
-            self._enter_prevote_wait(self.height, r)
+    def _check_vote_quorums(self, vr: Optional[int] = None) -> None:
+        """Quorum-driven step transitions (state.go addVote tail), keyed on
+        the VOTE's round: a quorum can complete in a round other than the
+        one this node is currently in (e.g. we timed out into round r+1
+        just before the last round-r precommit arrived)."""
+        if vr is None:
+            vr = self.round
+        prevotes = self.votes.prevotes(vr)
+        if vr == self.round and \
+                self.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
+            if prevotes.has_two_thirds_majority():
+                self._enter_precommit(self.height, vr)
+            elif prevotes.has_two_thirds_any():
+                self._enter_prevote_wait(self.height, vr)
+        elif vr > self.round and prevotes.has_two_thirds_any():
+            # round skip (state.go:2260): the network has moved on
+            self._enter_new_round(self.height, vr)
 
-        precommits = self.votes.precommits(r)
+        precommits = self.votes.precommits(vr)
         maj = precommits.two_thirds_majority()
         if maj is not None:
-            if maj.is_nil():
-                if self.step >= STEP_PRECOMMIT:
-                    self._enter_new_round(self.height, r + 1)
+            # state.go addVote: enterNewRound -> enterPrecommit ->
+            # enterCommit/enterPrecommitWait — our own precommit must be
+            # signed (and lock bookkeeping done) even when the majority
+            # formed before we reached STEP_PRECOMMIT ourselves
+            self._enter_new_round(self.height, vr)  # no-op unless vr > round
+            self._enter_precommit(self.height, vr)
+            if not maj.is_nil():
+                self._enter_commit(self.height, vr)
             else:
-                self._enter_commit(self.height, r)
-        elif self.step == STEP_PRECOMMIT and precommits.has_two_thirds_any():
-            self._enter_precommit_wait(self.height, r)
+                self._enter_precommit_wait(self.height, vr)
+        elif vr >= self.round and precommits.has_two_thirds_any():
+            self._enter_new_round(self.height, vr)
+            self._enter_precommit_wait(self.height, vr)
 
     # ---------------------------------------------------------------------
     # step: commit / finalize
@@ -559,7 +589,6 @@ class ConsensusState(BaseService):
             self.state, block_id, block
         )
         self.state = new_state
-        self._decided.set()
         self._advance_to_height(new_state)
 
     def _advance_to_height(self, new_state: State) -> None:
@@ -578,6 +607,7 @@ class ConsensusState(BaseService):
         )
         self.round_validators = new_state.validators
         self.commit_round = -1
+        self._triggered_precommit_wait = False
         self.ticker.schedule(TimeoutInfo(
             self.height, 0, STEP_NEW_HEIGHT, self.timeouts.commit,
         ))

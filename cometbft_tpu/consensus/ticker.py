@@ -56,9 +56,17 @@ class TimeoutTicker:
 
     def schedule(self, ti: TimeoutInfo) -> None:
         with self._lock:
-            if self._current is not None and ti[:3] <= self._current[:3] \
-                    and self._timer is not None and self._timer.is_alive():
-                pass  # older or same HRS — reference replaces regardless
+            # ticker.go timeoutRoutine: ignore timeouts for an older or
+            # equal (height, round, step) than the scheduled one — a
+            # lower-step schedule must never cancel a later-step timer
+            # (e.g. prevote-wait displacing the round's one-shot
+            # precommit-wait would deadlock the round)
+            if self._current is not None:
+                cur = self._current
+                if (ti.height, ti.round, ti.step) <= (
+                    cur.height, cur.round, cur.step
+                ):
+                    return
             if self._timer is not None:
                 self._timer.cancel()
             self._current = ti

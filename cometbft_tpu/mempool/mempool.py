@@ -44,6 +44,11 @@ class Mempool:
                 if len(self._txs) < self.max_txs and tx not in self._tx_set:
                     self._txs.append(tx)
                     self._tx_set.add(tx)
+        else:
+            # rejected txs leave the cache so they can be resubmitted once
+            # valid (clist_mempool.go: KeepInvalidTxsInCache=false default)
+            with self._lock:
+                self._cache.pop(tx, None)
         return resp
 
     def reap(self, max_bytes: int = -1, max_txs: int = -1) -> List[bytes]:

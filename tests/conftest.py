@@ -22,3 +22,13 @@ if not os.environ.get("CBT_TEST_ON_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the ed25519 verify kernel takes minutes to
+# compile on CPU; cache it across test runs (cache key includes backend +
+# jax version, so TPU runs are unaffected).
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/cbt_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
