@@ -44,7 +44,10 @@ from cometbft_tpu.types.commit import Commit
 from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.timestamp import Timestamp
 from cometbft_tpu.types.vote import Vote
-from cometbft_tpu.types.vote_set import ConflictingVoteError
+from cometbft_tpu.types.vote_set import (
+    ConflictingVoteError,
+    VoteSetError,
+)
 
 # RoundStep* (consensus/types/round_state.go:12-24)
 STEP_NEW_HEIGHT = 1
@@ -252,14 +255,15 @@ class ConsensusState(BaseService):
             if i < start or rec.kind != walmod.MSG_INFO:
                 continue
             # messages are WAL-logged BEFORE validation (state.go:820), so
-            # a record the live path rejected must not brick the restart —
-            # log and continue like the reference's catchupReplay
+            # a record the live path rejected must not brick the restart.
+            # Only DECODE errors are tolerated here — the handlers below
+            # swallow their own validation errors, and a genuine failure
+            # inside commit finalization must abort startup, not leave the
+            # node running on half-applied state.
             try:
                 j = json.loads(rec.data.decode())
                 if j["t"] == "vote":
                     vote = serde.vote_from_j(j["v"])
-                    if vote.height == self.height:
-                        self._try_add_vote(vote, from_replay=True)
                 elif j["t"] == "proposal":
                     p = j["p"]
                     prop = Proposal(
@@ -268,14 +272,24 @@ class ConsensusState(BaseService):
                         serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
                     )
                     block = serde.block_from_json(json.dumps(j["b"]))
-                    if prop.height == self.height:
-                        self._set_proposal(
-                            ProposalMsg(prop, block), from_replay=True
-                        )
-            except Exception:  # noqa: BLE001
+                else:
+                    continue
+            except Exception:  # noqa: BLE001 - corrupt record: skip
                 import traceback
 
                 traceback.print_exc()
+                continue
+            if j["t"] == "vote":
+                if vote.height == self.height:
+                    self._try_add_vote(vote, from_replay=True)
+            elif prop.height == self.height:
+                try:
+                    self._set_proposal(
+                        ProposalMsg(prop, block), from_replay=True
+                    )
+                except ValueError:
+                    # the live path rejected this proposal too
+                    pass
 
     # ---------------------------------------------------------------------
     # step: new round / propose
@@ -516,6 +530,11 @@ class ConsensusState(BaseService):
             added = self.votes.add_vote(vote, verify=True)
         except ConflictingVoteError:
             # evidence collection lands with the evidence pool
+            return
+        except VoteSetError:
+            # invalid vote (bad sig, unknown validator): logged-and-dropped
+            # in the reference too (state.go:2110 tryAddVote error arm) —
+            # and replay must tolerate records the live path rejected
             return
         if added:
             self._check_vote_quorums(vote.round)

@@ -1,0 +1,81 @@
+"""Batch-verifier dispatch: pick the device kernel by key type.
+
+Reference: crypto/batch/batch.go:12-32 (CreateBatchVerifier switches on
+key type; SupportsBatchVerifier gates the batch path). The TPU build goes
+further than the reference in two ways:
+- secp256k1 IS batchable here (the reference has no ECDSA batch path at
+  all — batch.go:12-21 only dispatches ed25519/sr25519);
+- one mixed-key commit verifies in a single call: rows are grouped by key
+  type and each group goes to its kernel (the device pads per-group, so a
+  mixed batch costs two kernel dispatches, not a serial fallback).
+
+The batch_fn signature used across validation.py: fn(pubs, msgs, sigs)
+with pubs a sequence of crypto.keys.PubKey; returns (n,) bool validity —
+the per-signature slice the blame path needs (types/validation.go:243).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+from cometbft_tpu.crypto.keys import (
+    ED25519_KEY_TYPE,
+    SECP256K1_KEY_TYPE,
+    PubKey,
+)
+
+_BATCHABLE = {ED25519_KEY_TYPE, SECP256K1_KEY_TYPE}
+
+
+def supports_batch_verifier(key_type: str) -> bool:
+    """crypto/batch/batch.go:24-32 analog (plus secp256k1)."""
+    return key_type in _BATCHABLE
+
+
+def _kernel_for(key_type: str) -> Callable:
+    if key_type == ED25519_KEY_TYPE:
+        from cometbft_tpu.ops import ed25519_kernel
+
+        return ed25519_kernel.verify_batch
+    if key_type == SECP256K1_KEY_TYPE:
+        from cometbft_tpu.ops import ecdsa_kernel
+
+        return ecdsa_kernel.verify_batch
+    raise ValueError(f"no batch verifier for key type {key_type!r}")
+
+
+def verify_batch(
+    pubs: Sequence[PubKey],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    kernels: dict = None,
+) -> np.ndarray:
+    """Verify a (possibly mixed-key-type) batch; (n,) bool validity.
+
+    kernels overrides the per-type kernel (e.g. the Pallas ed25519 path)."""
+    n = len(pubs)
+    valid = np.zeros((n,), np.bool_)
+    groups: dict = defaultdict(list)
+    for i, p in enumerate(pubs):
+        groups[p.key_type].append(i)
+    for kt, idxs in groups.items():
+        if kt not in _BATCHABLE:
+            # unknown type: per-row single verify (never raises mid-batch)
+            for i in idxs:
+                valid[i] = pubs[i].verify_signature(msgs[i], sigs[i])
+            continue
+        kernel = (kernels or {}).get(kt) or _kernel_for(kt)
+        sub = kernel(
+            [pubs[i].data for i in idxs],
+            [msgs[i] for i in idxs],
+            [sigs[i] for i in idxs],
+        )
+        valid[np.asarray(idxs)] = np.asarray(sub)
+    return valid
+
+
+def batch_fn() -> Callable:
+    """The batch_fn validation.py consumes (CreateBatchVerifier analog)."""
+    return verify_batch

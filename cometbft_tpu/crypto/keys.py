@@ -37,17 +37,30 @@ SECP256K1_KEY_TYPE = "secp256k1"
 
 @dataclass(frozen=True)
 class PubKey:
-    """An ed25519 public key (32 raw bytes)."""
+    """A public key: ed25519 (32 raw bytes) or secp256k1 (33 compressed)."""
 
     data: bytes
     key_type: str = ED25519_KEY_TYPE
 
     def address(self) -> bytes:
-        """20-byte address: SHA256(pubkey)[:20] (crypto/crypto.go:18)."""
+        """20-byte address: SHA256(pubkey)[:20] for ed25519
+        (crypto/crypto.go:18), RIPEMD160(SHA256(pubkey)) for secp256k1
+        (crypto/secp256k1/secp256k1.go:131)."""
+        if self.key_type == SECP256K1_KEY_TYPE:
+            from cometbft_tpu.crypto import secp256k1_ref
+
+            return secp256k1_ref.address(self.data)
         return tmhash.sum_truncated(self.data)
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        """ZIP-215 single verify (crypto/ed25519/ed25519.go:181)."""
+        """Single verify: ZIP-215 for ed25519 (crypto/ed25519/ed25519.go:181),
+        low-S-enforcing ECDSA for secp256k1 (secp256k1.go:192-220)."""
+        if self.key_type == SECP256K1_KEY_TYPE:
+            from cometbft_tpu.crypto import secp256k1_ref
+
+            return secp256k1_ref.verify(self.data, msg, sig)
+        if self.key_type != ED25519_KEY_TYPE:
+            raise ValueError(f"unsupported key type {self.key_type!r}")
         return ed25519_ref.verify(self.data, msg, sig)
 
     def __bytes__(self) -> bytes:
@@ -86,3 +99,41 @@ class PrivKey:
     def sign(self, msg: bytes) -> bytes:
         """RFC 8032 deterministic signature via OpenSSL."""
         return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
+
+
+@dataclass(frozen=True)
+class Secp256k1PrivKey:
+    """A secp256k1 private key (32-byte big-endian scalar).
+
+    Reference: crypto/secp256k1/secp256k1.go:24-129 (GenPrivKey, Sign
+    producing 64-byte r||s with low-S normalization)."""
+
+    data: bytes
+
+    @staticmethod
+    def generate(seed: Optional[bytes] = None) -> "Secp256k1PrivKey":
+        from cometbft_tpu.crypto import secp256k1_ref as sref
+
+        if seed is None:
+            import os as _os
+
+            seed = _os.urandom(32)
+        # fold the seed onto [1, N) like the reference's rejection loop
+        d = int.from_bytes(seed, "big") % (sref.N - 1) + 1
+        return Secp256k1PrivKey(d.to_bytes(32, "big"))
+
+    @property
+    def secret(self) -> int:
+        return int.from_bytes(self.data, "big")
+
+    def pub_key(self) -> PubKey:
+        from cometbft_tpu.crypto import secp256k1_ref as sref
+
+        return PubKey(
+            sref.pubkey_from_secret(self.secret), SECP256K1_KEY_TYPE
+        )
+
+    def sign(self, msg: bytes) -> bytes:
+        from cometbft_tpu.crypto import secp256k1_ref as sref
+
+        return sref.sign(self.secret, msg)

@@ -1,0 +1,130 @@
+"""Pure-Python secp256k1 ECDSA oracle (host reference for the device kernel).
+
+Semantics mirror the reference's secp256k1 component
+(crypto/secp256k1/secp256k1.go):
+- 33-byte compressed pubkeys (0x02/0x03 prefix),
+- 64-byte r||s big-endian signatures,
+- VerifySignature rejects malleable (high-S) signatures
+  (secp256k1.go:204-208),
+- address = RIPEMD160(SHA256(compressed pubkey)) (secp256k1.go:131).
+
+This module is a test oracle and host-side signer; bulk verification
+routes to the batched device kernel (ops/secp256k1.py). Signing uses
+OpenSSL (`cryptography`) with the signature normalized to low-S.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+# Curve parameters: y^2 = x^3 + 7 over F_p, group order N.
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+B = 7
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+HALF_N = N // 2
+
+
+# -- affine group ops (None = point at infinity) ---------------------------
+
+
+def pt_add(a: Optional[Tuple[int, int]], b: Optional[Tuple[int, int]]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    x1, y1 = a
+    x2, y2 = b
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * pow(2 * y1, P - 2, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def pt_mul(k: int, p: Optional[Tuple[int, int]]):
+    acc = None
+    while k:
+        if k & 1:
+            acc = pt_add(acc, p)
+        p = pt_add(p, p)
+        k >>= 1
+    return acc
+
+
+# -- encoding --------------------------------------------------------------
+
+
+def compress(x: int, y: int) -> bytes:
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def decompress(pub: bytes) -> Optional[Tuple[int, int]]:
+    """33-byte compressed key -> (x, y), or None if invalid/not on curve."""
+    if len(pub) != 33 or pub[0] not in (2, 3):
+        return None
+    x = int.from_bytes(pub[1:], "big")
+    if x >= P:
+        return None
+    yy = (pow(x, 3, P) + B) % P
+    y = pow(yy, (P + 1) // 4, P)  # p ≡ 3 (mod 4)
+    if y * y % P != yy:
+        return None
+    if (y & 1) != (pub[0] & 1):
+        y = P - y
+    return x, y
+
+
+def pubkey_from_secret(d: int) -> bytes:
+    x, y = pt_mul(d, (GX, GY))
+    return compress(x, y)
+
+
+def address(pub: bytes) -> bytes:
+    """RIPEMD160(SHA256(compressed pubkey)) (secp256k1.go:131)."""
+    return hashlib.new("ripemd160", hashlib.sha256(pub).digest()).digest()
+
+
+# -- sign / verify ---------------------------------------------------------
+
+
+def sign(d: int, msg: bytes) -> bytes:
+    """ECDSA-SHA256, low-S normalized, 64-byte r||s big-endian."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    sk = ec.derive_private_key(d, ec.SECP256K1())
+    r, s = decode_dss_signature(sk.sign(msg, ec.ECDSA(hashes.SHA256())))
+    if s > HALF_N:
+        s = N - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """ECDSA verify with the reference's malleability rule: s > N/2 is
+    rejected outright (secp256k1.go:204-208)."""
+    if len(sig) != 64:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s <= HALF_N):
+        return False
+    q = decompress(pub)
+    if q is None:
+        return False
+    z = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    w = pow(s, N - 2, N)
+    u1 = z * w % N
+    u2 = r * w % N
+    pt = pt_add(pt_mul(u1, (GX, GY)), pt_mul(u2, q))
+    if pt is None:
+        return False
+    return pt[0] % N == r

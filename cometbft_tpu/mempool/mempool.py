@@ -41,9 +41,19 @@ class Mempool:
         resp = self.app.check_tx(abci.RequestCheckTx(tx=tx))
         if resp.code == abci.CODE_TYPE_OK:
             with self._lock:
-                if len(self._txs) < self.max_txs and tx not in self._tx_set:
+                if tx in self._tx_set:
+                    pass
+                elif len(self._txs) < self.max_txs:
                     self._txs.append(tx)
                     self._tx_set.add(tx)
+                else:
+                    # mempool full: drop AND un-cache so a resubmission
+                    # isn't silently swallowed forever (clist_mempool.go
+                    # removes err'd txs from the cache); surface the drop
+                    self._cache.pop(tx, None)
+                    return abci.ResponseCheckTx(
+                        code=1, log="mempool is full"
+                    )
         else:
             # rejected txs leave the cache so they can be resubmitted once
             # valid (clist_mempool.go: KeepInvalidTxsInCache=false default)

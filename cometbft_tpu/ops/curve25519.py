@@ -157,7 +157,7 @@ def scalar_mul_windowed(digits, p):
     table = jnp.concatenate([ident[None], tbl], axis=0)  # (16,B,4,n)
     table = jnp.moveaxis(table, 0, 1)  # (B, 16, 4, n)
 
-    digits_t = digits.T  # (64, B)
+    digits_t = jnp.asarray(digits).T  # (64, B)
 
     def lookup(d):
         return jnp.take_along_axis(
@@ -210,7 +210,7 @@ def base_scalar_mul(digits):
     Comb method: 64 table adds, no doublings.
     """
     bt = base_table()
-    digits_t = digits.T  # (64, B)
+    digits_t = jnp.asarray(digits).T  # (64, B)
 
     def body(i, acc):
         row = jax.lax.dynamic_index_in_dim(bt, i, 0, keepdims=False)

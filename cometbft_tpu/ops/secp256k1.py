@@ -1,0 +1,197 @@
+"""Batched secp256k1 point arithmetic on the 13-bit-limb JAX field.
+
+Points are int32 arrays of shape (..., 3, NLIMBS) holding homogeneous
+projective coordinates (X : Y : Z), x = X/Z, y = Y/Z on y^2 = x^3 + 7.
+Addition and doubling use the Renes–Costello–Batina *complete* formulas
+for a = 0 short-Weierstrass curves (eprint 2015/1060, algorithms 7 and 9):
+no exceptional cases for identity/doubling inputs, so the batch kernel is
+branch-free — the same property the edwards25519 kernel gets from the
+unified a=-1 formulas.
+
+The reference has no secp256k1 curve arithmetic of its own (it delegates
+to btcsuite/btcec, SURVEY.md §2.1) and no batch verifier for it at all
+(crypto/batch/batch.go:12-21) — this module is where the TPU build goes
+beyond reference capability.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.crypto import secp256k1_ref as ref
+from cometbft_tpu.ops.field import FSECP, NLIMBS
+
+F = FSECP
+B3 = 3 * ref.B  # 21: the only curve constant in the complete formulas
+
+
+def identity(shape=()):
+    """The point at infinity (0 : 1 : 0), broadcast over leading dims."""
+    one = F.const(1, shape)
+    zero = jnp.zeros_like(one)
+    return jnp.stack([zero, one, zero], axis=-2)
+
+
+def identity_like(batch_ref):
+    """Identity points (B, 3, NLIMBS) with mesh-varying type inherited from
+    batch_ref (see curve25519.identity_like for why this matters under
+    shard_map)."""
+    Bn = batch_ref.shape[0]
+    vzero = (batch_ref.reshape(Bn, -1)[:, :1] * 0).astype(jnp.int32)[..., None]
+    return identity((Bn,)) + vzero
+
+
+def from_affine_int(x: int, y: int) -> np.ndarray:
+    """Host: build a (3, NLIMBS) point from affine Python ints."""
+    return np.stack([F.from_int(x), F.from_int(y), F.from_int(1)])
+
+
+def unstack(p):
+    return p[..., 0, :], p[..., 1, :], p[..., 2, :]
+
+
+def stack(x, y, z):
+    return jnp.stack([x, y, z], axis=-2)
+
+
+def add(p, q):
+    """Complete addition, RCB 2015 algorithm 7 specialized to a=0, b3=21
+    (12 mul + 2 small-const mul)."""
+    X1, Y1, Z1 = unstack(p)
+    X2, Y2, Z2 = unstack(q)
+    t0 = F.mul(X1, X2)
+    t1 = F.mul(Y1, Y2)
+    t2 = F.mul(Z1, Z2)
+    t3 = F.mul(F.add(X1, Y1), F.add(X2, Y2))
+    t3 = F.sub(t3, F.add(t0, t1))  # X1*Y2 + X2*Y1
+    t4 = F.mul(F.add(Y1, Z1), F.add(Y2, Z2))
+    t4 = F.sub(t4, F.add(t1, t2))  # Y1*Z2 + Y2*Z1
+    X3 = F.mul(F.add(X1, Z1), F.add(X2, Z2))
+    Y3 = F.sub(X3, F.add(t0, t2))  # X1*Z2 + X2*Z1
+    t0 = F.mul_small(t0, 3)
+    t2 = F.mul_small(t2, B3)
+    Z3 = F.add(t1, t2)
+    t1 = F.sub(t1, t2)
+    Y3 = F.mul_small(Y3, B3)
+    X3 = F.sub(F.mul(t3, t1), F.mul(t4, Y3))
+    Y3 = F.add(F.mul(t1, Z3), F.mul(Y3, t0))
+    Z3 = F.add(F.mul(Z3, t4), F.mul(t0, t3))
+    return stack(X3, Y3, Z3)
+
+
+def double(p):
+    """Complete doubling, RCB 2015 algorithm 9 (a=0): 6 mul + 2 sq."""
+    X, Y, Z = unstack(p)
+    t0 = F.square(Y)
+    Z3 = F.mul_small(t0, 8)
+    t1 = F.mul(Y, Z)
+    t2 = F.mul_small(F.square(Z), B3)
+    X3 = F.mul(t2, Z3)
+    Y3 = F.add(t0, t2)
+    Z3 = F.mul(t1, Z3)
+    t2 = F.mul_small(t2, 3)
+    t0 = F.sub(t0, t2)
+    Y3 = F.add(X3, F.mul(t0, Y3))
+    X3 = F.mul_small(F.mul(F.mul(X, Y), t0), 2)
+    return stack(X3, Y3, Z3)
+
+
+def neg(p):
+    X, Y, Z = unstack(p)
+    return stack(X, F.neg(Y), Z)
+
+
+def select(cond, p, q):
+    return jnp.where(cond[..., None, None], p, q)
+
+
+def is_identity(p):
+    """Projective infinity check: Z == 0."""
+    _, _, Z = unstack(p)
+    return F.is_zero(Z)
+
+
+def decompress(x_limbs, parity_bits):
+    """Batched compressed-key decompression.
+
+    x_limbs: (..., NLIMBS) the x coordinate (host prechecks x < p);
+    parity_bits: (...,) int32 — the 0x02/0x03 prefix's low bit.
+    Returns (point, ok); contents are garbage when ok=False.
+    """
+    x = x_limbs
+    yy = F.add(F.mul(F.square(x), x), F.const(ref.B, x.shape[:-1]))
+    y = F.pow_const(yy, (ref.P + 1) // 4)  # p ≡ 3 (mod 4)
+    ok = F.eq(F.square(y), yy)
+    flip = F.parity(y) != parity_bits
+    y = F.select(flip, F.neg(y), y)
+    return stack(x, y, F.const(1, x.shape[:-1])), ok
+
+
+def scalar_mul_windowed(digits, p):
+    """[k]P for per-element points; k as (B, 64) base-16 LE digits.
+
+    Same window structure as curve25519.scalar_mul_windowed: 15-add table
+    scan, then 63 x (4 doublings + table add)."""
+
+    def table_step(prev, _):
+        nxt = add(prev, p)
+        return nxt, nxt
+
+    ident = identity_like(digits)
+    _, tbl = jax.lax.scan(table_step, ident, None, length=15)
+    table = jnp.concatenate([ident[None], tbl], axis=0)
+    table = jnp.moveaxis(table, 0, 1)  # (B, 16, 3, n)
+
+    digits_t = jnp.asarray(digits).T  # (64, B)
+
+    def lookup(d):
+        return jnp.take_along_axis(
+            table, d[:, None, None, None], axis=1
+        ).squeeze(1)
+
+    def body(i, acc):
+        w = 62 - i
+        d = jax.lax.dynamic_index_in_dim(digits_t, w, 0, keepdims=False)
+        acc = double(double(double(double(acc))))
+        return add(acc, lookup(d))
+
+    acc0 = lookup(digits_t[63])
+    return jax.lax.fori_loop(0, 63, body, acc0)
+
+
+_BASE_TABLE = None
+
+
+def base_table_np() -> np.ndarray:
+    """(64, 16, 3, NLIMBS) comb table as NUMPY: entry [w][d] = [d*16^w]G."""
+    global _BASE_TABLE
+    if _BASE_TABLE is None:
+        rows = []
+        for w in range(64):
+            step = pow(16, w, ref.N)
+            row = []
+            for d in range(16):
+                pt = ref.pt_mul(d * step, (ref.GX, ref.GY))
+                if pt is None:
+                    row.append(
+                        np.stack([F.from_int(0), F.from_int(1), F.from_int(0)])
+                    )
+                else:
+                    row.append(from_affine_int(pt[0], pt[1]))
+            rows.append(np.stack(row))
+        _BASE_TABLE = np.stack(rows)
+    return _BASE_TABLE
+
+
+def base_scalar_mul(digits):
+    """[k]G via the comb table: 64 adds, no doublings."""
+    bt = jnp.asarray(base_table_np())
+    digits_t = jnp.asarray(digits).T  # jnp: numpy input + tracer index
+
+    def body(i, acc):
+        row = jax.lax.dynamic_index_in_dim(bt, i, 0, keepdims=False)
+        entry = jnp.take(row, digits_t[i], axis=0)
+        return add(acc, entry)
+
+    return jax.lax.fori_loop(0, 64, body, identity_like(digits))

@@ -211,7 +211,7 @@ def _verify_batch(
       the first invalid collected index, which is exactly where the
       single-verify fallback would stop.
     """
-    pubs: List[bytes] = []
+    pubs: List = []  # crypto.keys.PubKey — batch_fn groups by key_type
     msgs: List[bytes] = []
     sigs: List[bytes] = []
     idxs: List[int] = []
@@ -232,7 +232,7 @@ def _verify_batch(
                 )
             seen.add(cs.validator_address)
         pub_key, power = resolved
-        pubs.append(pub_key.data)
+        pubs.append(pub_key)
         msgs.append(commit.vote_sign_bytes(chain_id, idx))
         sigs.append(cs.signature)
         idxs.append(idx)
@@ -290,32 +290,35 @@ def _verify_single(
 
 
 def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
-    """Build a batch_fn backed by the batched TPU verifier.
+    """Build a batch_fn backed by the batched TPU verifiers.
 
-    Returns fn(pubs, msgs, sigs) -> (n,) bool validity. Pallas on TPU
-    backends, XLA-composed kernel elsewhere (interpret-mode Pallas on CPU
-    is far slower than the XLA path). The voting-power tally stays host-
+    Returns fn(pubs: [PubKey], msgs, sigs) -> (n,) bool validity, with
+    rows grouped by key type (crypto/batch.py dispatch): ed25519 via the
+    Pallas kernel on TPU backends / XLA-composed kernel elsewhere
+    (interpret-mode Pallas on CPU is far slower than the XLA path),
+    secp256k1 via the ECDSA kernel. The voting-power tally stays host-
     side here because VerifyCommit's early-break collection is inherently
     sequential; the fused device tally serves the streaming paths
     (blocksync replay) where whole commits are verified unconditionally.
     """
     import jax
 
+    from cometbft_tpu.crypto import batch as cbatch
     from cometbft_tpu.ops import ed25519_kernel as ek
 
     if use_pallas is None:
         use_pallas = jax.default_backend() not in ("cpu",)
 
-    def fn(pubs, msgs, sigs):
-        n = len(pubs)
+    def ed25519_verify(pub_bytes, msgs, sigs):
+        n = len(pub_bytes)
         if use_pallas:
             from cometbft_tpu.ops import ed25519_pallas as kp
 
             pad = kp.pad_to_tile(n)
-            pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
+            pb = ek.pack_batch(pub_bytes, msgs, sigs, pad_to=pad)
             valid = np.asarray(kp.verify_pallas(*kp.pack_transposed(pb)))
         else:
-            pb = ek.pack_batch(pubs, msgs, sigs)
+            pb = ek.pack_batch(pub_bytes, msgs, sigs)
             valid = np.asarray(
                 ek.verify_kernel(
                     pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig,
@@ -324,16 +327,20 @@ def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
             )
         return valid[:n]
 
+    def fn(pubs, msgs, sigs):
+        return cbatch.verify_batch(
+            pubs, msgs, sigs, kernels={"ed25519": ed25519_verify}
+        )
+
     return fn
 
 
 def oracle_batch_fn() -> Callable:
     """Pure-Python batch_fn (differential-test reference, no device)."""
-    from cometbft_tpu.crypto import ed25519_ref
 
     def fn(pubs, msgs, sigs):
         return np.asarray(
-            [ed25519_ref.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+            [p.verify_signature(m, s) for p, m, s in zip(pubs, msgs, sigs)]
         )
 
     return fn
