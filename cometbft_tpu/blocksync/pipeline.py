@@ -1,0 +1,225 @@
+"""Streaming multi-commit verification pipeline — the TPU blocksync core.
+
+Reference shape: blocksync/reactor.go:463 verifies each streamed block's
+commit serially (`state.Validators.VerifyCommitLight(...)` once per
+block, ~1k sigs each). The TPU restructuring packs MANY consecutive
+commits into one fused device pass: every signature row carries a
+commit_id, the kernel verifies all rows in parallel and computes each
+commit's voting-power quorum bit with a segmented one-hot tally
+(ed25519_kernel.tally_core), so a 16k-signature pass retires ~16 blocks
+of 1k validators at once.
+
+Double buffering comes free from JAX async dispatch: the kernel call for
+chunk k returns immediately, so the host packs chunk k+1 while the device
+works; fetching chunk k's results overlaps the next dispatch
+(SURVEY.md §7 stage 2's H2D-hiding requirement).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cometbft_tpu.ops import ed25519_kernel as ek
+from cometbft_tpu.types.commit import Commit
+from cometbft_tpu.types.validation import (
+    InvalidSignatureError,
+    NotEnoughPowerError,
+    VerificationError,
+    _verify_basic,
+)
+from cometbft_tpu.types.validator import ValidatorSet
+
+# Fixed commit-axis padding: keeps the kernel's static n_commits constant
+# across runs (one compile per signature bucket, not per run length).
+MAX_COMMITS_PER_CHUNK = 64
+
+
+@dataclass
+class CommitJob:
+    """One block's commit to verify (the VerifyCommitLight arguments)."""
+
+    vals: ValidatorSet
+    block_id: object
+    height: int
+    commit: Commit
+    chain_id: str
+
+
+@dataclass
+class _Chunk:
+    jobs: List  # [(global_idx, CommitJob)]
+    row_job: np.ndarray   # (n,) job index per signature row
+    row_idx: np.ndarray   # (n,) commit-signature index per row (blame)
+    pending: tuple        # device arrays in flight
+
+
+class StreamVerifier:
+    """Packs CommitJobs into fused multi-commit device passes.
+
+    verify(jobs) returns a list of Optional[VerificationError] — None for
+    a commit that verified with quorum, the failure otherwise (bad sig
+    rows get InvalidSignatureError with the exact commit-sig index, like
+    the reference's per-sig blame fallback, types/validation.go:243-250).
+    """
+
+    def __init__(self, max_sigs: int = 16384, use_pallas: bool = False):
+        self.max_sigs = max_sigs
+        self.use_pallas = use_pallas
+
+    # -- packing -----------------------------------------------------------
+
+    def _pack_chunk(self, jobs) -> Optional[_Chunk]:
+        """jobs: [(global_idx, CommitJob)] for this chunk."""
+        pubs: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        row_job: List[int] = []
+        row_idx: List[int] = []
+        powers: List[int] = []
+        for j, (_, job) in enumerate(jobs):
+            for idx, cs in enumerate(job.commit.signatures):
+                if not cs.for_block():
+                    continue
+                val = job.vals.get_by_index(idx)
+                if val is None:
+                    continue
+                pubs.append(val.pub_key.data)
+                msgs.append(job.commit.vote_sign_bytes(job.chain_id, idx))
+                sigs.append(cs.signature)
+                row_job.append(j)
+                row_idx.append(idx)
+                powers.append(val.voting_power)
+        if not pubs:
+            return None
+        n = len(pubs)
+        if self.use_pallas:
+            from cometbft_tpu.ops import ed25519_pallas as kp
+
+            pad = kp.pad_to_tile(n)
+        else:
+            pad = ek.bucket_size(n)
+        pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
+        power5 = np.zeros((pad, ek.POWER_LIMBS), np.int32)
+        power5[:n] = ek.power_limbs(np.asarray(powers, np.int64))
+        counted = np.zeros((pad,), np.bool_)
+        counted[:n] = True
+        # the commit dimension is PADDED to a fixed size: n_commits is a
+        # static arg of the jit'd kernel, so a varying count would force a
+        # recompile (minutes on CPU) for every distinct run length
+        c_pad = MAX_COMMITS_PER_CHUNK + 1
+        commit_ids = np.zeros((pad,), np.int32)
+        commit_ids[:n] = np.asarray(row_job, np.int32)
+        # padding rows tally into the sink commit id so they can't pollute
+        # job 0's quorum
+        commit_ids[n:] = c_pad - 1
+        thresh = np.zeros((c_pad, ek.TALLY_LIMBS), np.int32)
+        thresh[:, -1] = ek.POWER_MASK  # unused/sink: unreachable threshold
+        for j, (_, job) in enumerate(jobs):
+            thresh[j] = ek.threshold_limbs(
+                job.vals.total_voting_power() * 2 // 3
+            )[0]
+
+        pending = self._dispatch(pb, power5, counted, commit_ids, thresh,
+                                 c_pad)
+        return _Chunk(jobs, np.asarray(row_job), np.asarray(row_idx),
+                      pending)
+
+    def _dispatch(self, pb, power5, counted, commit_ids, thresh, n_commits):
+        if self.use_pallas:
+            from cometbft_tpu.ops import ed25519_pallas as kp
+
+            return kp.verify_tally_pallas(
+                *kp.pack_transposed(pb), power5, counted, commit_ids, thresh
+            )
+        return ek.verify_tally_kernel(
+            pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig, pb.precheck,
+            power5, counted, commit_ids, thresh, n_commits,
+        )
+
+    # -- the streaming loop ------------------------------------------------
+
+    def _chunk_indexed(self, indexed):
+        """Split [(global_idx, job)] into chunks of <= max_sigs rows."""
+        cur, cur_sigs = [], 0
+        for gi, job in indexed:
+            n = len(job.commit.signatures)
+            if cur and (cur_sigs + n > self.max_sigs
+                        or len(cur) >= MAX_COMMITS_PER_CHUNK):
+                yield cur
+                cur, cur_sigs = [], 0
+            cur.append((gi, job))
+            cur_sigs += n
+        if cur:
+            yield cur
+
+    def verify(
+        self, jobs: Sequence[CommitJob]
+    ) -> List[Optional[VerificationError]]:
+        results: List[Optional[VerificationError]] = [None] * len(jobs)
+        done = set()
+        # structural prechecks stay host-side (cheap, no device round trip)
+        for i, job in enumerate(jobs):
+            try:
+                _verify_basic(job.vals, job.block_id, job.height, job.commit)
+            except VerificationError as e:
+                results[i] = e
+                done.add(i)
+
+        # commits with non-ed25519 validators route to the grouped batch
+        # dispatch (crypto/batch.py handles mixed key types); the fused
+        # multi-commit pass below assumes uniform ed25519 rows
+        for i, job in enumerate(jobs):
+            if i in done:
+                continue
+            if any(
+                v.pub_key.key_type != "ed25519" for v in job.vals.validators
+            ):
+                from cometbft_tpu.types import validation as tv
+
+                try:
+                    tv.verify_commit_light(
+                        job.chain_id, job.vals, job.block_id, job.height,
+                        job.commit, tv.device_batch_fn(),
+                    )
+                except VerificationError as e:
+                    results[i] = e
+                done.add(i)
+
+        indexed = [(i, j) for i, j in enumerate(jobs) if i not in done]
+        in_flight: List[_Chunk] = []
+        for chunk_pairs in self._chunk_indexed(indexed):
+            chunk = self._pack_chunk(chunk_pairs)
+            if chunk is not None:
+                in_flight.append(chunk)
+            # keep at most 2 chunks in flight: fetch the oldest while the
+            # newest computes (double buffering)
+            if len(in_flight) > 2:
+                self._collect(in_flight.pop(0), results)
+        for chunk in in_flight:
+            self._collect(chunk, results)
+        return results
+
+    def _collect(self, chunk: _Chunk, results) -> None:
+        valid, tally, quorum = chunk.pending
+        valid = np.asarray(valid)
+        quorum = np.asarray(quorum)
+        for j, (gi, job) in enumerate(chunk.jobs):
+            rows = chunk.row_job == j
+            row_valid = valid[: len(chunk.row_job)][rows]
+            if not row_valid.all():
+                bad = chunk.row_idx[rows][~row_valid][0]
+                results[gi] = InvalidSignatureError(int(bad))
+            elif not bool(quorum[j]):
+                needed = job.vals.total_voting_power() * 2 // 3
+                results[gi] = NotEnoughPowerError(-1, needed)
+
+
+def make_stream_verifier(use_pallas: Optional[bool] = None,
+                         max_sigs: int = 16384) -> StreamVerifier:
+    if use_pallas is None:
+        import jax
+
+        use_pallas = jax.default_backend() not in ("cpu",)
+    return StreamVerifier(max_sigs=max_sigs, use_pallas=use_pallas)

@@ -1,0 +1,174 @@
+"""BlockPool: sliding-window parallel block requester.
+
+Reference: blocksync/pool.go — 600 outstanding requests (:31-34), max 20
+per peer, requesters re-assign on peer failure, PeekTwoBlocks/PopRequest
+consumed by the reactor, peer height tracking via status messages.
+
+Transport-agnostic: a peer is registered with a `request(height)`
+callback (the p2p reactor wires a real channel; tests wire a local
+chain). Blocks come back through add_block."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cometbft_tpu.types.block import Block
+
+MAX_PENDING_REQUESTS = 600   # pool.go:32 maxPendingRequests
+MAX_PER_PEER = 20            # pool.go:33 maxPendingRequestsPerPeer
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    height: int
+    request: Callable[[int], None]
+    pending: int = 0
+    banned: bool = False
+
+
+@dataclass
+class _Requester:
+    height: int
+    peer_id: Optional[str] = None
+    block: Optional[Block] = None
+
+
+class BlockPool:
+    def __init__(self, start_height: int):
+        self.height = start_height  # next height to process
+        self._peers: Dict[str, _Peer] = {}
+        self._requesters: Dict[int, _Requester] = {}
+        self._lock = threading.Lock()
+
+    # -- peer management ---------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, height: int,
+                       request: Callable[[int], None]) -> None:
+        """SetPeerRange (pool.go): register/refresh a peer and its tip."""
+        with self._lock:
+            p = self._peers.get(peer_id)
+            if p is None:
+                self._peers[peer_id] = _Peer(peer_id, height, request)
+            else:
+                p.height = max(p.height, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+            for r in self._requesters.values():
+                if r.peer_id == peer_id and r.block is None:
+                    r.peer_id = None  # re-assignable
+
+    def ban_peer(self, peer_id: str) -> None:
+        """Reactor punishes a peer that served a bad block
+        (blocksync/reactor.go:480-496); its pending blocks are dropped."""
+        with self._lock:
+            p = self._peers.pop(peer_id, None)
+            if p:
+                p.banned = True
+            for r in self._requesters.values():
+                if r.peer_id == peer_id:
+                    r.peer_id = None
+                    r.block = None
+
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return max((p.height for p in self._peers.values()), default=0)
+
+    # -- request scheduling ------------------------------------------------
+
+    def make_requests(self) -> int:
+        """Fill the sliding window: assign unclaimed heights to peers with
+        capacity. Returns how many requests were issued."""
+        issued = []
+        with self._lock:
+            window_end = self.height + MAX_PENDING_REQUESTS
+            for h in range(self.height, window_end):
+                if h > self.max_peer_height_locked():
+                    break
+                r = self._requesters.get(h)
+                if r is None:
+                    r = self._requesters[h] = _Requester(h)
+                if r.peer_id is not None or r.block is not None:
+                    continue
+                peer = self._pick_peer(h)
+                if peer is None:
+                    continue
+                r.peer_id = peer.peer_id
+                peer.pending += 1
+                issued.append((peer, h))
+        for peer, h in issued:
+            peer.request(h)
+        return len(issued)
+
+    def max_peer_height_locked(self) -> int:
+        return max((p.height for p in self._peers.values()), default=0)
+
+    def _pick_peer(self, height: int) -> Optional[_Peer]:
+        best = None
+        for p in self._peers.values():
+            if p.height < height or p.pending >= MAX_PER_PEER:
+                continue
+            if best is None or p.pending < best.pending:
+                best = p
+        return best
+
+    # -- block intake ------------------------------------------------------
+
+    def add_block(self, peer_id: str, block: Block) -> bool:
+        """AddBlock (pool.go): only accepted from the peer the height was
+        requested from (anti-spam)."""
+        with self._lock:
+            r = self._requesters.get(block.header.height)
+            if r is None or r.peer_id != peer_id or r.block is not None:
+                return False
+            r.block = block
+            p = self._peers.get(peer_id)
+            if p:
+                p.pending = max(0, p.pending - 1)
+            return True
+
+    # -- consumption -------------------------------------------------------
+
+    def peek_blocks(self, max_n: int = 2) -> List[Block]:
+        """A run of consecutive available blocks starting at self.height
+        (PeekTwoBlocks generalized — the fused multi-commit verifier eats
+        as long a run as is ready)."""
+        out: List[Block] = []
+        with self._lock:
+            for h in range(self.height, self.height + max_n):
+                r = self._requesters.get(h)
+                if r is None or r.block is None:
+                    break
+                out.append(r.block)
+        return out
+
+    def pop_block(self) -> None:
+        """Advance past self.height (PopRequest)."""
+        with self._lock:
+            self._requesters.pop(self.height, None)
+            self.height += 1
+
+    def redo_block(self, height: int) -> Optional[str]:
+        """A block failed verification: drop it (and everything above it
+        from the same peer) for re-request; returns the offending peer."""
+        with self._lock:
+            r = self._requesters.get(height)
+            if r is None:
+                return None
+            peer = r.peer_id
+            for h, req in self._requesters.items():
+                if h >= height and req.peer_id == peer:
+                    req.block = None
+                    req.peer_id = None
+            return peer
+
+    def is_caught_up(self) -> bool:
+        """pool.go IsCaughtUp: blocks up to maxPeerHeight-1 are applied
+        (verifying height H needs H+1's LastCommit); consensus takes the
+        tip after the switch."""
+        with self._lock:
+            maxh = self.max_peer_height_locked()
+            return maxh > 0 and self.height >= maxh

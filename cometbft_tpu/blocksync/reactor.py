@@ -1,0 +1,155 @@
+"""Blocksync reactor: catch up by streaming historical blocks through the
+fused batch verifier, then hand off to consensus.
+
+Reference: blocksync/reactor.go — poolRoutine (:286) peeks consecutive
+blocks, verifies the first via the second's LastCommit
+(`VerifyCommitLight`, :463), applies through the BlockExecutor (:513),
+bans peers serving bad blocks (:480-496), switches to consensus when
+caught up (:391-401).
+
+TPU restructuring: instead of one VerifyCommitLight per block, a RUN of
+consecutive ready blocks is verified in one fused multi-commit device
+pass (pipeline.StreamVerifier). Validator-set changes mid-run are
+handled by re-verifying from the height where the set changed — the
+optimistic batch is correct whenever the set is stable, which is the
+overwhelmingly common case in replay."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from cometbft_tpu.blocksync.pipeline import CommitJob, StreamVerifier
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types.block import Block
+
+MAX_RUN = 64  # blocks fused per device pass (64 x 1k sigs fills a bucket)
+
+
+class BlocksyncReactor(BaseService):
+    def __init__(
+        self,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        stream_verifier: Optional[StreamVerifier] = None,
+        on_caught_up: Optional[Callable[[State], None]] = None,
+        poll_interval: float = 0.02,
+    ):
+        super().__init__("BlocksyncReactor")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.pool = BlockPool(state.last_block_height + 1)
+        self.verifier = stream_verifier or StreamVerifier(use_pallas=False)
+        self.on_caught_up = on_caught_up
+        self.poll_interval = poll_interval
+        self.banned_peers: List[str] = []
+        self._thread: Optional[threading.Thread] = None
+
+    # -- service -----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._pool_routine, daemon=True, name="blocksync"
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- peer API (wired by p2p or tests) ----------------------------------
+
+    def add_peer(self, peer_id: str, height: int,
+                 request: Callable[[int], None]) -> None:
+        self.pool.set_peer_range(peer_id, height, request)
+
+    def receive_block(self, peer_id: str, block: Block) -> None:
+        self.pool.add_block(peer_id, block)
+
+    # -- the sync loop -----------------------------------------------------
+
+    def _pool_routine(self) -> None:
+        """poolRoutine (reactor.go:286)."""
+        while self.is_running():
+            self.pool.make_requests()
+            if self.pool.is_caught_up():
+                if self.on_caught_up:
+                    self.on_caught_up(self.state)
+                return
+            # need blocks h..h+k AND h+k+1 (its LastCommit seals h+k)
+            run = self.pool.peek_blocks(MAX_RUN + 1)
+            if len(run) < 2:
+                time.sleep(self.poll_interval)
+                continue
+            self._process_run(run)
+
+    def _process_run(self, run: List[Block]) -> None:
+        """Verify blocks run[0..n-2] using each successor's LastCommit in
+        one fused pass, then apply them in order."""
+        n = len(run) - 1
+        jobs = []
+        for i in range(n):
+            first, second = run[i], run[i + 1]
+            jobs.append(CommitJob(
+                vals=self.state.validators,  # optimistic: stable valset
+                block_id=first.block_id(),
+                height=first.header.height,
+                commit=second.last_commit,
+                chain_id=self.state.chain_id,
+            ))
+        results = self.verifier.verify(jobs)
+        # staleness marker: bumps exactly when a validator update lands
+        # (state/execution.py _update_state). Once it moves, every
+        # remaining job in the run was packed against a stale set and is
+        # re-verified individually (epoch changes are rare in replay).
+        pack_marker = self.state.last_height_validators_changed
+
+        for i in range(n):
+            first, second = run[i], run[i + 1]
+            if self.state.last_height_validators_changed != pack_marker:
+                redo = self.verifier.verify([CommitJob(
+                    vals=self.state.validators,
+                    block_id=first.block_id(),
+                    height=first.header.height,
+                    commit=second.last_commit,
+                    chain_id=self.state.chain_id,
+                )])
+                results[i] = redo[0]
+            if results[i] is not None:
+                peer = self.pool.redo_block(first.header.height)
+                if peer:
+                    self.pool.ban_peer(peer)
+                    self.banned_peers.append(peer)
+                return  # stop the run; loop re-requests and retries
+            try:
+                self.block_exec.validate_block(self.state, first)
+                self.block_store.save_block(first, second.last_commit)
+                self.state = self.block_exec.apply_block(
+                    self.state, first.block_id(), first
+                )
+            except Exception:
+                peer = self.pool.redo_block(first.header.height)
+                if peer:
+                    self.pool.ban_peer(peer)
+                    self.banned_peers.append(peer)
+                return
+            self.pool.pop_block()
+
+    # -- introspection -----------------------------------------------------
+
+    def height(self) -> int:
+        return self.state.last_block_height
+
+    def wait_caught_up(self, timeout: float = 60.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.pool.is_caught_up() or not self.is_running():
+                return True
+            time.sleep(0.02)
+        return False

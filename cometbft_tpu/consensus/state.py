@@ -19,6 +19,7 @@ transport.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import struct
 import threading
@@ -48,6 +49,8 @@ from cometbft_tpu.types.vote_set import (
     ConflictingVoteError,
     VoteSetError,
 )
+
+_log = logging.getLogger(__name__)
 
 # RoundStep* (consensus/types/round_state.go:12-24)
 STEP_NEW_HEIGHT = 1
@@ -283,13 +286,13 @@ class ConsensusState(BaseService):
                 if vote.height == self.height:
                     self._try_add_vote(vote, from_replay=True)
             elif prop.height == self.height:
+                from cometbft_tpu.types.proposal import ProposalError
+
                 try:
-                    self._set_proposal(
-                        ProposalMsg(prop, block), from_replay=True
-                    )
-                except ValueError:
+                    self._set_proposal(ProposalMsg(prop, block))
+                except (ValueError, ProposalError) as e:
                     # the live path rejected this proposal too
-                    pass
+                    _log.warning("replay: dropped invalid proposal: %s", e)
 
     # ---------------------------------------------------------------------
     # step: new round / propose
@@ -374,9 +377,13 @@ class ConsensusState(BaseService):
     def _proposal_complete(self) -> bool:
         return self.proposal is not None and self.proposal_block is not None
 
-    def _set_proposal(self, msg: ProposalMsg, from_replay: bool = False) \
-            -> None:
-        """state.go:1890 defaultSetProposal + addProposalBlockPart."""
+    def _set_proposal(self, msg: ProposalMsg) -> None:
+        """state.go:1890 defaultSetProposal + addProposalBlockPart.
+
+        The signature is verified on BOTH the live and replay paths: the
+        WAL logs proposals before validation, so a replay that skipped
+        verification would turn a live-rejected forgery into the accepted
+        proposal after restart."""
         if self.proposal is not None:
             return
         p = msg.proposal
@@ -384,9 +391,7 @@ class ConsensusState(BaseService):
             return
         p.validate_basic()
         proposer = self._proposer()
-        if not from_replay and not p.verify(
-            self.state.chain_id, proposer.pub_key
-        ):
+        if not p.verify(self.state.chain_id, proposer.pub_key):
             raise ValueError("invalid proposal signature")
         if msg.block.hash() != p.block_id.hash:
             raise ValueError("proposal block hash mismatch")
@@ -531,10 +536,13 @@ class ConsensusState(BaseService):
         except ConflictingVoteError:
             # evidence collection lands with the evidence pool
             return
-        except VoteSetError:
+        except VoteSetError as e:
             # invalid vote (bad sig, unknown validator): logged-and-dropped
             # in the reference too (state.go:2110 tryAddVote error arm) —
             # and replay must tolerate records the live path rejected
+            _log.warning("dropped invalid vote h=%d r=%d from %s: %s",
+                         vote.height, vote.round,
+                         vote.validator_address.hex()[:12], e)
             return
         if added:
             self._check_vote_quorums(vote.round)

@@ -62,9 +62,13 @@ def verify_batch(
         groups[p.key_type].append(i)
     for kt, idxs in groups.items():
         if kt not in _BATCHABLE:
-            # unknown type: per-row single verify (never raises mid-batch)
+            # unknown type: per-row single verify; a type with no verifier
+            # at all marks the row invalid instead of raising mid-batch
             for i in idxs:
-                valid[i] = pubs[i].verify_signature(msgs[i], sigs[i])
+                try:
+                    valid[i] = pubs[i].verify_signature(msgs[i], sigs[i])
+                except ValueError:
+                    valid[i] = False
             continue
         kernel = (kernels or {}).get(kt) or _kernel_for(kt)
         sub = kernel(

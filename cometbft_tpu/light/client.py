@@ -1,0 +1,234 @@
+"""Light client: trust-period verification with sequential or skipping
+(bisection) modes, primary + witness providers, trusted store.
+
+Reference: light/client.go:174 (Client), VerifyLightBlockAtHeight (:474),
+verifySequential (:613), verifySkipping (:706: bisection driven by
+ErrNewValSetCantBeTrusted), detector.go (witness cross-examination ->
+divergence errors), light/store (trusted light-block store).
+
+The expensive inner step — VerifyCommitLight/Trusting over hundreds or
+thousands of signatures — runs on the batched device verifier; bisection
+turns a 10k-block gap into O(log) fused device passes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cometbft_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrNewValSetCantBeTrusted,
+    LightBlock,
+    LightClientError,
+    header_expired,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+
+
+class Provider:
+    """Light-block source (light/provider/provider.go): an RPC node in the
+    reference; any callable source here."""
+
+    def __init__(self, chain_id: str,
+                 fetch: Callable[[int], Optional[LightBlock]]):
+        self.chain_id = chain_id
+        self._fetch = fetch
+
+    def light_block(self, height: int) -> LightBlock:
+        lb = self._fetch(height)
+        if lb is None:
+            raise LightClientError(f"provider has no light block {height}")
+        return lb
+
+
+class DivergenceError(LightClientError):
+    """A witness returned a conflicting header (detector.go divergence)."""
+
+    def __init__(self, witness_idx: int, msg: str = ""):
+        self.witness_idx = witness_idx
+        super().__init__(msg or f"witness {witness_idx} diverged")
+
+
+class TrustedStore:
+    """In-memory trusted light-block store (light/store/db analog)."""
+
+    def __init__(self):
+        self._blocks: Dict[int, LightBlock] = {}
+        self._lock = threading.Lock()
+
+    def save(self, lb: LightBlock) -> None:
+        with self._lock:
+            self._blocks[lb.height] = lb
+
+    def get(self, height: int) -> Optional[LightBlock]:
+        with self._lock:
+            return self._blocks.get(height)
+
+    def delete(self, height: int) -> None:
+        with self._lock:
+            self._blocks.pop(height, None)
+
+    def latest(self) -> Optional[LightBlock]:
+        with self._lock:
+            if not self._blocks:
+                return None
+            return self._blocks[max(self._blocks)]
+
+    def heights(self) -> List[int]:
+        with self._lock:
+            return sorted(self._blocks)
+
+
+class Client:
+    """light.Client (light/client.go:174)."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        primary: Provider,
+        witnesses: Optional[List[Provider]] = None,
+        trusting_period: float = 14 * 24 * 3600.0,
+        trust_level: Tuple[int, int] = DEFAULT_TRUST_LEVEL,
+        max_clock_drift: float = 10.0,
+        batch_fn: Optional[Callable] = None,
+        skipping: bool = True,
+    ):
+        self.chain_id = chain_id
+        self.primary = primary
+        self.witnesses = witnesses or []
+        self.trusting_period = trusting_period
+        self.trust_level = trust_level
+        self.max_clock_drift = max_clock_drift
+        self.batch_fn = batch_fn
+        self.skipping = skipping
+        self.store = TrustedStore()
+        # instrumentation for tests/benchmarks (bisection step count)
+        self.verifications = 0
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def trust_light_block(self, lb: LightBlock) -> None:
+        """Initialize trust from a social-consensus root (light/client.go
+        initializeWithTrustOptions analog; hash pinning happens upstream)."""
+        lb.validate_basic(self.chain_id)
+        self.store.save(lb)
+
+    # -- core API ----------------------------------------------------------
+
+    def verify_light_block_at_height(
+        self, height: int, now: Optional[Timestamp] = None
+    ) -> LightBlock:
+        """VerifyLightBlockAtHeight (light/client.go:474)."""
+        now = now or Timestamp.now()
+        got = self.store.get(height)
+        if got is not None:
+            return got
+        latest = self.store.latest()
+        if latest is None:
+            raise LightClientError("no trusted state: call trust_light_block")
+        if height <= latest.height:
+            raise LightClientError(
+                f"height {height} <= latest trusted {latest.height}; "
+                "backwards verification not required by the sync paths"
+            )
+        target = self.primary.light_block(height)
+        target.validate_basic(self.chain_id)
+        if self.skipping:
+            self._verify_skipping(latest, target, now)
+        else:
+            self._verify_sequential(latest, target, now)
+        self._cross_check(target)
+        self.store.save(target)
+        return target
+
+    # -- verification strategies ------------------------------------------
+
+    def _verify_one(self, trusted: LightBlock, new: LightBlock,
+                    now: Timestamp) -> None:
+        self.verifications += 1
+        if new.height == trusted.height + 1:
+            verify_adjacent(
+                self.chain_id, trusted.signed_header, new.signed_header,
+                new.validator_set, self.trusting_period, now,
+                self.max_clock_drift, self.batch_fn,
+            )
+        else:
+            verify_non_adjacent(
+                self.chain_id, trusted.signed_header,
+                trusted.validator_set,  # vals at trusted height sign h+1..
+                new.signed_header, new.validator_set,
+                self.trusting_period, now, self.max_clock_drift,
+                self.trust_level, self.batch_fn,
+            )
+
+    def _verify_sequential(self, trusted: LightBlock, target: LightBlock,
+                           now: Timestamp) -> None:
+        """light/client.go:613 verifySequential: walk every height."""
+        cur = trusted
+        for h in range(trusted.height + 1, target.height):
+            nxt = self.primary.light_block(h)
+            nxt.validate_basic(self.chain_id)
+            self._verify_one(cur, nxt, now)
+            self.store.save(nxt)
+            cur = nxt
+        self._verify_one(cur, target, now)
+
+    def _verify_skipping(self, trusted: LightBlock, target: LightBlock,
+                         now: Timestamp) -> None:
+        """light/client.go:706 verifySkipping: try the jump; on
+        ErrNewValSetCantBeTrusted bisect toward the trusted height."""
+        cur = trusted
+        pivot_stack: List[LightBlock] = [target]
+        while pivot_stack:
+            candidate = pivot_stack[-1]
+            try:
+                self._verify_one(cur, candidate, now)
+            except ErrNewValSetCantBeTrusted:
+                pivot_h = (cur.height + candidate.height) // 2
+                if pivot_h in (cur.height, candidate.height):
+                    raise LightClientError(
+                        "bisection exhausted: validator set changed too "
+                        "much between adjacent heights"
+                    )
+                pivot = self.primary.light_block(pivot_h)
+                pivot.validate_basic(self.chain_id)
+                pivot_stack.append(pivot)
+                continue
+            self.store.save(candidate)
+            cur = candidate
+            pivot_stack.pop()
+
+    # -- witness cross-examination ----------------------------------------
+
+    def _cross_check(self, verified: LightBlock) -> None:
+        """detector.go: compare the verified header against every witness;
+        a mismatching header hash is a divergence (fork) signal."""
+        want = verified.signed_header.header.hash()
+        for i, w in enumerate(self.witnesses):
+            try:
+                alt = w.light_block(verified.height)
+            except LightClientError:
+                continue  # unresponsive witness is skipped, not fatal
+            if alt.signed_header.header.hash() != want:
+                raise DivergenceError(
+                    i,
+                    f"witness {i} header {alt.signed_header.header.hash()!r}"
+                    f" != primary {want!r} at height {verified.height}",
+                )
+
+    # -- maintenance -------------------------------------------------------
+
+    def prune_expired(self, now: Optional[Timestamp] = None) -> int:
+        """Drop trusted blocks outside the trusting period."""
+        now = now or Timestamp.now()
+        dropped = 0
+        for h in self.store.heights():
+            lb = self.store.get(h)
+            if lb and header_expired(
+                lb.signed_header.header, self.trusting_period, now
+            ):
+                self.store.delete(h)
+                dropped += 1
+        return dropped

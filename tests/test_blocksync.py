@@ -1,0 +1,154 @@
+"""Blocksync: fused multi-commit stream verification, catch-up from a
+peer's block store, bad-peer banning.
+
+Mirrors blocksync/reactor_test.go + pool_test.go structure: a real chain
+is produced by a single-validator node, then fresh nodes catch up from
+peers serving that store."""
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync.pipeline import CommitJob, StreamVerifier
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types import validation
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+FAST = TimeoutParams(propose=0.4, propose_delta=0.1, prevote=0.2,
+                     prevote_delta=0.1, precommit=0.2, precommit_delta=0.1,
+                     commit=0.01)
+CHAIN_HEIGHT = 24
+
+
+@pytest.fixture(scope="module")
+def chain(tmp_path_factory):
+    """A real 24-block chain + its genesis state, produced by one node."""
+    home = str(tmp_path_factory.mktemp("chain") / "n0")
+    priv = PrivKey.generate(b"\x55" * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    genesis = State.make_genesis("sync-chain", vals)
+    node = Node(KVStoreApplication(), genesis, privval=FilePV(priv),
+                home=home, timeouts=FAST)
+    node.start()
+    assert node.consensus.wait_for_height(CHAIN_HEIGHT + 1, timeout=60)
+    node.stop()
+    store = BlockStore(home + "/blockstore.db")
+    return genesis, store
+
+
+def serve_from(store, reactor, peer_id, height):
+    """Wire a BlockStore up as a peer: requests are served synchronously."""
+    def request(h):
+        blk = store.load_block(h)
+        if blk is not None:
+            reactor.receive_block(peer_id, blk)
+
+    reactor.add_peer(peer_id, height, request)
+
+
+def fresh_reactor(chain, tmp_path, name="sync"):
+    from dataclasses import replace
+
+    from cometbft_tpu.abci.types import RequestInitChain
+
+    genesis, _ = chain
+    app = KVStoreApplication()
+    ri = app.init_chain(RequestInitChain(chain_id=genesis.chain_id))
+    state = genesis.copy()
+    if ri.app_hash:
+        state = replace(state, app_hash=ri.app_hash)
+    state_store = StateStore(str(tmp_path / f"{name}-state.db"))
+    block_store = BlockStore(str(tmp_path / f"{name}-blocks.db"))
+    block_exec = BlockExecutor(app, state_store, mempool=Mempool(app))
+    return BlocksyncReactor(state, block_exec, block_store,
+                            StreamVerifier(use_pallas=False))
+
+
+def test_stream_verifier_multi_commit(chain):
+    """Many commits fused into one device pass: per-commit quorum bits and
+    exact blame indices."""
+    genesis, store = chain
+    jobs = []
+    for h in range(1, 9):
+        blk = store.load_block(h)
+        commit = store.load_seen_commit(h)
+        jobs.append(CommitJob(genesis.validators, blk.block_id(), h, commit,
+                              genesis.chain_id))
+    sv = StreamVerifier(use_pallas=False)
+    assert sv.verify(jobs) == [None] * 8
+
+    # tamper job 3's signature; truncate job 5's quorum (absent-ify)
+    import copy
+
+    bad = copy.deepcopy(jobs)
+    sig = bytearray(bad[3].commit.signatures[0].signature)
+    sig[7] ^= 1
+    bad[3].commit.signatures[0].signature = bytes(sig)
+    bad[5].commit.signatures[0].flag = 1  # BLOCK_ID_FLAG_ABSENT
+    bad[5].commit.signatures[0].signature = b""
+    res = sv.verify(bad)
+    assert res[0] is None and res[7] is None
+    assert isinstance(res[3], validation.InvalidSignatureError)
+    assert res[3].idx == 0
+    assert isinstance(res[5], validation.NotEnoughPowerError)
+
+
+def test_catchup_from_one_peer(chain, tmp_path):
+    genesis, store = chain
+    reactor = fresh_reactor(chain, tmp_path, "one")
+    caught = []
+    reactor.on_caught_up = lambda st: caught.append(st.last_block_height)
+    serve_from(store, reactor, "peer-a", CHAIN_HEIGHT)
+    reactor.start()
+    try:
+        assert reactor.wait_caught_up(30)
+        # blocksync applies up to maxPeerHeight-1; consensus takes over for
+        # the tip (pool.go IsCaughtUp semantics)
+        assert reactor.height() == CHAIN_HEIGHT - 1
+        assert caught and caught[0] == CHAIN_HEIGHT - 1
+        assert reactor.block_store.load_block(CHAIN_HEIGHT - 1) is not None
+    finally:
+        reactor.stop()
+
+
+def test_bad_peer_banned_good_peer_completes(chain, tmp_path):
+    genesis, store = chain
+    reactor = fresh_reactor(chain, tmp_path, "ban")
+
+    class EvilStore:
+        """Serves block 5 with a corrupted LastCommit for block 4."""
+
+        def load_block(self, h):
+            blk = store.load_block(h)
+            if blk is not None and h == 5 and blk.last_commit.signatures:
+                import copy
+
+                blk = copy.deepcopy(blk)
+                sig = bytearray(blk.last_commit.signatures[0].signature)
+                sig[3] ^= 0xFF
+                blk.last_commit.signatures[0].signature = bytes(sig)
+            return blk
+
+    serve_from(EvilStore(), reactor, "evil", CHAIN_HEIGHT)
+    reactor.start()
+    try:
+        # evil is the only peer: the corrupted LastCommit must get it banned
+        import time
+
+        deadline = time.time() + 20
+        while "evil" not in reactor.banned_peers:
+            assert time.time() < deadline, "evil peer never banned"
+            time.sleep(0.02)
+        # an honest peer then completes the sync
+        serve_from(store, reactor, "good", CHAIN_HEIGHT)
+        assert reactor.wait_caught_up(30)
+        assert reactor.height() == CHAIN_HEIGHT - 1
+        assert "evil" in reactor.banned_peers
+    finally:
+        reactor.stop()

@@ -1,0 +1,181 @@
+"""Light client: adjacent/non-adjacent verification, bisection across
+validator-set churn, witness divergence, trusting-period expiry.
+
+Mirrors light/client_test.go + light/verifier_test.go case structure with
+an in-process chain generator standing in for the RPC providers.
+"""
+import pytest
+
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.light import client as lc
+from cometbft_tpu.light import verifier as lv
+from cometbft_tpu.types import canonical, validation
+from cometbft_tpu.types.block import Header
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import (
+    BLOCK_ID_FLAG_COMMIT,
+    Commit,
+    CommitSig,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+CHAIN_ID = "light-chain"
+T0 = 1_700_000_000
+
+
+def keys_for(tag, n):
+    return [
+        PrivKey.generate(bytes([tag, i + 1]) + b"\x07" * 30)
+        for i in range(n)
+    ]
+
+
+class LightChain:
+    """Deterministic chain builder: vals_plan[h] is the key list whose set
+    signs height h; headers carry correct validators/next_validators
+    hashes so adjacent links and bisection behave like the real chain."""
+
+    def __init__(self, vals_plan):
+        self.plan = vals_plan  # dict height -> list[PrivKey]
+        self.max_height = max(vals_plan)
+        self.blocks = {}
+        prev_bid = BlockID()
+        for h in range(1, self.max_height + 1):
+            privs = self.plan[h]
+            nxt = self.plan.get(h + 1, privs)
+            vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+            nvs = ValidatorSet([Validator(p.pub_key(), 10) for p in nxt])
+            header = Header(
+                chain_id=CHAIN_ID, height=h,
+                time=Timestamp(T0 + h, 0),
+                last_block_id=prev_bid,
+                validators_hash=vs.hash(),
+                next_validators_hash=nvs.hash(),
+                proposer_address=vs.validators[0].address,
+                app_hash=b"\x01" * 32,
+            )
+            bid = BlockID(header.hash(), PartSetHeader(1, header.hash()))
+            by_addr = {p.pub_key().address(): p for p in privs}
+            sigs = []
+            for v in vs.validators:
+                ts = Timestamp(T0 + h, 42)
+                sb = canonical.canonical_vote_bytes(
+                    CHAIN_ID, canonical.PRECOMMIT_TYPE, h, 0, bid, ts
+                )
+                sigs.append(CommitSig(
+                    BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                    by_addr[v.address].sign(sb),
+                ))
+            self.blocks[h] = lv.LightBlock(
+                lv.SignedHeader(header, Commit(h, 0, bid, sigs)), vs
+            )
+            prev_bid = bid
+
+    def provider(self):
+        return lc.Provider(CHAIN_ID, lambda h: self.blocks.get(h))
+
+
+NOW = Timestamp(T0 + 1000, 0)
+
+
+def make_client(chain, **kw):
+    kw.setdefault("trusting_period", 1e6)
+    kw.setdefault("batch_fn", validation.oracle_batch_fn())
+    c = lc.Client(CHAIN_ID, chain.provider(), **kw)
+    c.trust_light_block(chain.blocks[1])
+    return c
+
+
+def test_skipping_one_jump_stable_valset():
+    """Stable validator set: one non-adjacent verification reaches the
+    target (the whole point of skipping mode)."""
+    keys = keys_for(1, 4)
+    chain = LightChain({h: keys for h in range(1, 21)})
+    c = make_client(chain)
+    lb = c.verify_light_block_at_height(20, now=NOW)
+    assert lb.height == 20
+    assert c.verifications == 1
+
+
+def test_sequential_walks_every_height():
+    keys = keys_for(1, 4)
+    chain = LightChain({h: keys for h in range(1, 11)})
+    c = make_client(chain, skipping=False)
+    c.verify_light_block_at_height(10, now=NOW)
+    assert c.verifications == 9
+    assert c.store.heights() == list(range(1, 11))
+
+
+def test_bisection_across_full_valset_rotation():
+    """Heights 1-10 signed by era A, 11-20 by a disjoint era B: a direct
+    jump fails the 1/3-trust check and bisection + the adjacent
+    next-validators link must carry the client across (client.go:706)."""
+    a, b = keys_for(1, 4), keys_for(2, 4)
+    plan = {h: (a if h <= 10 else b) for h in range(1, 21)}
+    chain = LightChain(plan)
+    c = make_client(chain)
+    lb = c.verify_light_block_at_height(20, now=NOW)
+    assert lb.height == 20
+    # must have passed through the era boundary via the adjacent link
+    assert 11 in c.store.heights()
+    assert c.verifications > 2
+
+
+def test_gradual_churn_skips_far():
+    """Replacing one of 6 validators every 3 heights keeps >1/3 overlap on
+    moderate jumps — skipping should NOT need every height."""
+    base = keys_for(3, 8)
+    plan = {}
+    cur = list(base)
+    for h in range(1, 31):
+        if h % 3 == 0:
+            cur = cur[1:] + [keys_for(10 + h, 1)[0]]
+        plan[h] = list(cur)
+    chain = LightChain(plan)
+    c = make_client(chain)
+    c.verify_light_block_at_height(30, now=NOW)
+    assert c.verifications < 29  # strictly better than sequential
+
+
+def test_expired_trusted_header_rejected():
+    keys = keys_for(1, 4)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    c = make_client(chain, trusting_period=10.0)
+    with pytest.raises(lv.ErrOldHeaderExpired):
+        c.verify_light_block_at_height(5, now=Timestamp(T0 + 1000, 0))
+
+
+def test_witness_divergence_detected():
+    keys = keys_for(1, 4)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    forged = LightChain({h: keys_for(9, 4) for h in range(1, 6)})
+    c = lc.Client(
+        CHAIN_ID, chain.provider(),
+        witnesses=[forged.provider()],
+        trusting_period=1e6, batch_fn=validation.oracle_batch_fn(),
+    )
+    c.trust_light_block(chain.blocks[1])
+    with pytest.raises(lc.DivergenceError):
+        c.verify_light_block_at_height(5, now=NOW)
+
+
+def test_tampered_target_rejected():
+    keys = keys_for(1, 4)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    # swap height 5's commit sigs for garbage
+    lb = chain.blocks[5]
+    bad_sigs = [
+        CommitSig(cs.flag, cs.validator_address, cs.timestamp, bytes(64))
+        for cs in lb.signed_header.commit.signatures
+    ]
+    chain.blocks[5] = lv.LightBlock(
+        lv.SignedHeader(
+            lb.signed_header.header,
+            Commit(5, 0, lb.signed_header.commit.block_id, bad_sigs),
+        ),
+        lb.validator_set,
+    )
+    c = make_client(chain)
+    with pytest.raises(lv.ErrInvalidHeader):
+        c.verify_light_block_at_height(5, now=NOW)
