@@ -191,7 +191,14 @@ class StreamVerifier:
         in_flight: List[_Chunk] = []
         for chunk_pairs in self._chunk_indexed(indexed):
             chunk = self._pack_chunk(chunk_pairs)
-            if chunk is not None:
+            if chunk is None:
+                # zero packable rows (e.g. every signature ABSENT): fail
+                # CLOSED — these commits tallied no power at all
+                for gi, job in chunk_pairs:
+                    results[gi] = NotEnoughPowerError(
+                        0, job.vals.total_voting_power() * 2 // 3
+                    )
+            else:
                 in_flight.append(chunk)
             # keep at most 2 chunks in flight: fetch the oldest while the
             # newest computes (double buffering)

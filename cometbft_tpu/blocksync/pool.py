@@ -25,7 +25,6 @@ class _Peer:
     height: int
     request: Callable[[int], None]
     pending: int = 0
-    banned: bool = False
 
 
 @dataclass
@@ -39,6 +38,7 @@ class BlockPool:
     def __init__(self, start_height: int):
         self.height = start_height  # next height to process
         self._peers: Dict[str, _Peer] = {}
+        self._banned: set = set()
         self._requesters: Dict[int, _Requester] = {}
         self._lock = threading.Lock()
 
@@ -48,6 +48,8 @@ class BlockPool:
                        request: Callable[[int], None]) -> None:
         """SetPeerRange (pool.go): register/refresh a peer and its tip."""
         with self._lock:
+            if peer_id in self._banned:
+                return  # a banned peer can't re-register via status spam
             p = self._peers.get(peer_id)
             if p is None:
                 self._peers[peer_id] = _Peer(peer_id, height, request)
@@ -65,17 +67,12 @@ class BlockPool:
         """Reactor punishes a peer that served a bad block
         (blocksync/reactor.go:480-496); its pending blocks are dropped."""
         with self._lock:
-            p = self._peers.pop(peer_id, None)
-            if p:
-                p.banned = True
+            self._banned.add(peer_id)
+            self._peers.pop(peer_id, None)
             for r in self._requesters.values():
                 if r.peer_id == peer_id:
                     r.peer_id = None
                     r.block = None
-
-    def max_peer_height(self) -> int:
-        with self._lock:
-            return max((p.height for p in self._peers.values()), default=0)
 
     # -- request scheduling ------------------------------------------------
 
@@ -86,7 +83,7 @@ class BlockPool:
         with self._lock:
             window_end = self.height + MAX_PENDING_REQUESTS
             for h in range(self.height, window_end):
-                if h > self.max_peer_height_locked():
+                if h > self._max_peer_height():
                     break
                 r = self._requesters.get(h)
                 if r is None:
@@ -103,7 +100,7 @@ class BlockPool:
             peer.request(h)
         return len(issued)
 
-    def max_peer_height_locked(self) -> int:
+    def _max_peer_height(self) -> int:
         return max((p.height for p in self._peers.values()), default=0)
 
     def _pick_peer(self, height: int) -> Optional[_Peer]:
@@ -151,6 +148,11 @@ class BlockPool:
             self._requesters.pop(self.height, None)
             self.height += 1
 
+    def peer_of(self, height: int) -> Optional[str]:
+        with self._lock:
+            r = self._requesters.get(height)
+            return r.peer_id if r else None
+
     def redo_block(self, height: int) -> Optional[str]:
         """A block failed verification: drop it (and everything above it
         from the same peer) for re-request; returns the offending peer."""
@@ -170,5 +172,5 @@ class BlockPool:
         (verifying height H needs H+1's LastCommit); consensus takes the
         tip after the switch."""
         with self._lock:
-            maxh = self.max_peer_height_locked()
+            maxh = self._max_peer_height()
             return maxh > 0 and self.height >= maxh

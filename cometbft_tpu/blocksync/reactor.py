@@ -122,10 +122,7 @@ class BlocksyncReactor(BaseService):
                 )])
                 results[i] = redo[0]
             if results[i] is not None:
-                peer = self.pool.redo_block(first.header.height)
-                if peer:
-                    self.pool.ban_peer(peer)
-                    self.banned_peers.append(peer)
+                self._punish_pair(first.header.height)
                 return  # stop the run; loop re-requests and retries
             try:
                 self.block_exec.validate_block(self.state, first)
@@ -134,12 +131,21 @@ class BlocksyncReactor(BaseService):
                     self.state, first.block_id(), first
                 )
             except Exception:
-                peer = self.pool.redo_block(first.header.height)
-                if peer:
-                    self.pool.ban_peer(peer)
-                    self.banned_peers.append(peer)
+                self._punish_pair(first.header.height)
                 return
             self.pool.pop_block()
+
+    def _punish_pair(self, height: int) -> None:
+        """Either block of the failed (h, h+1) pair may be the bad one:
+        the reference redoes and punishes BOTH sides
+        (blocksync/reactor.go:480-496) — banning only h's server would let
+        a malicious h+1 LastCommit get honest peers banned one by one."""
+        peers = {self.pool.peer_of(height), self.pool.peer_of(height + 1)}
+        self.pool.redo_block(height)
+        self.pool.redo_block(height + 1)
+        for peer in peers - {None}:
+            self.pool.ban_peer(peer)
+            self.banned_peers.append(peer)
 
     # -- introspection -----------------------------------------------------
 

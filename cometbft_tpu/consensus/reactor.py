@@ -1,0 +1,109 @@
+"""Consensus reactor: gossips proposals and votes over the p2p switch.
+
+Reference: consensus/reactor.go — channels State/Data/Vote/VoteSetBits
+0x20-0x23 (:28-31), Receive demux (:241), per-peer gossip routines
+(:569,:737). This build floods proposals and votes on two channels
+(correct, if chattier than the reference's PeerState-bitarray-driven
+gossip; the dedup below keeps re-floods bounded) and relays on first
+sight so votes propagate beyond direct neighbors.
+"""
+from __future__ import annotations
+
+import json
+from typing import List
+
+from cometbft_tpu.consensus.state import ConsensusState, ProposalMsg
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.switch import Peer, Reactor
+from cometbft_tpu.types import serde
+from cometbft_tpu.types.proposal import Proposal
+
+DATA_CHANNEL = 0x21   # proposals + blocks (reactor.go DataChannel)
+VOTE_CHANNEL = 0x22   # votes (reactor.go VoteChannel)
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState):
+        super().__init__("CONSENSUS")
+        self.cs = cs
+        cs.broadcast = self._broadcast_own
+        self._seen_votes = set()
+        self._seen_proposals = set()
+
+    def channel_descriptors(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(VOTE_CHANNEL, priority=7,
+                              send_queue_capacity=2000),
+        ]
+
+    # -- outbound ----------------------------------------------------------
+
+    def _broadcast_own(self, msg) -> None:
+        kind, payload = msg
+        if self.switch is None:
+            return
+        if kind == "vote":
+            self.switch.broadcast(VOTE_CHANNEL, _vote_bytes(payload))
+        elif kind == "proposal":
+            self.switch.broadcast(DATA_CHANNEL, _proposal_bytes(payload))
+
+    # -- inbound -----------------------------------------------------------
+
+    def receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        try:
+            if chan_id == VOTE_CHANNEL:
+                vote = serde.vote_from_j(json.loads(msg.decode()))
+                key = (vote.height, vote.round, vote.vote_type,
+                       vote.validator_address, vote.signature)
+                if key in self._seen_votes:
+                    return
+                self._seen_votes.add(key)
+                if len(self._seen_votes) > 50000:
+                    self._seen_votes.clear()
+                self.cs.receive_vote(vote)
+                # relay so votes reach non-neighbors (flood w/ dedup)
+                self.switch.broadcast(VOTE_CHANNEL, msg)
+            elif chan_id == DATA_CHANNEL:
+                pm = _proposal_from_bytes(msg)
+                key = (pm.proposal.height, pm.proposal.round,
+                       pm.proposal.signature)
+                if key in self._seen_proposals:
+                    return
+                self._seen_proposals.add(key)
+                if len(self._seen_proposals) > 1000:
+                    self._seen_proposals.clear()
+                self.cs.receive_proposal(pm)
+                self.switch.broadcast(DATA_CHANNEL, msg)
+        except Exception as e:  # noqa: BLE001 - bad peer message
+            self.switch.stop_peer_for_error(peer, f"bad consensus msg: {e}")
+
+
+def _vote_bytes(vote) -> bytes:
+    return json.dumps(serde.vote_to_j(vote)).encode()
+
+
+def _proposal_bytes(pm: ProposalMsg) -> bytes:
+    p = pm.proposal
+    return json.dumps({
+        "p": {
+            "height": p.height, "round": p.round,
+            "pol_round": p.pol_round,
+            "block_id": serde.bid_to_j(p.block_id),
+            "ts": serde.ts_to_j(p.timestamp),
+            "sig": p.signature.hex(),
+        },
+        "b": json.loads(serde.block_to_json(pm.block)),
+    }).encode()
+
+
+def _proposal_from_bytes(msg: bytes) -> ProposalMsg:
+    j = json.loads(msg.decode())
+    p = j["p"]
+    prop = Proposal(
+        p["height"], p["round"], p["pol_round"],
+        serde.bid_from_j(p["block_id"]),
+        serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
+    )
+    return ProposalMsg(prop, serde.block_from_json(json.dumps(j["b"])))

@@ -95,8 +95,9 @@ class LightBlock:
 
 
 def header_expired(h: Header, trusting_period: float, now: Timestamp) -> bool:
-    """HeaderExpired (light/verifier.go:234)."""
-    return now.to_ns() / 1e9 >= h.time.to_ns() / 1e9 + trusting_period
+    """HeaderExpired (light/verifier.go:234). Integer-ns comparison:
+    float64 seconds lose ~400 ns of precision at current epochs."""
+    return now.to_ns() >= h.time.to_ns() + int(trusting_period * 1e9)
 
 
 def _check_new_header(
@@ -113,9 +114,9 @@ def _check_new_header(
             f"expected new header height {untrusted.height} > "
             f"trusted {trusted.height}"
         )
-    if untrusted.time.to_ns() / 1e9 <= trusted.time.to_ns() / 1e9:
+    if untrusted.time.to_ns() <= trusted.time.to_ns():
         raise ErrInvalidHeader("new header time <= trusted header time")
-    if untrusted.time.to_ns() / 1e9 > now.to_ns() / 1e9 + max_clock_drift:
+    if untrusted.time.to_ns() > now.to_ns() + int(max_clock_drift * 1e9):
         raise ErrInvalidHeader("new header time from the future")
 
 
@@ -137,7 +138,7 @@ def verify_non_adjacent(
     if header_expired(trusted.header, trusting_period, now):
         raise ErrOldHeaderExpired(
             f"trusted header expired at "
-            f"{trusted.time.to_ns() / 1e9 + trusting_period}"
+            f"{trusted.time.to_ns() // 10**9 + trusting_period}"
         )
     _check_new_header(chain_id, trusted, untrusted, now, max_clock_drift)
     if untrusted_vals.hash() != untrusted.header.validators_hash:

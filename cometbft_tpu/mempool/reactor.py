@@ -1,0 +1,37 @@
+"""Mempool reactor: tx gossip.
+
+Reference: mempool/reactor.go — MempoolChannel 0x30, per-peer send loops
+over the clist; here a flood with a seen-cache (the mempool's own dedup
+cache already bounds re-CheckTx work).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.switch import Peer, Reactor
+
+MEMPOOL_CHANNEL = 0x30
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+
+    def channel_descriptors(self) -> List[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    def broadcast_tx(self, tx: bytes) -> None:
+        """Called after a local CheckTx accept (rpc broadcast_tx path)."""
+        if self.switch is not None:
+            self.switch.broadcast(MEMPOOL_CHANNEL, tx)
+
+    def receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        resp = self.mempool.check_tx(msg)
+        # relay only txs WE accepted (first sight): the mempool cache
+        # makes repeat deliveries no-ops, bounding the flood
+        if resp.code == 0:
+            self.switch.broadcast(MEMPOOL_CHANNEL, msg)

@@ -33,6 +33,8 @@ class Node(BaseService):
         broadcast: Optional[Callable] = None,
         timeouts: Optional[TimeoutParams] = None,
         batch_fn: Optional[Callable] = None,
+        p2p: bool = False,
+        node_key=None,
     ):
         super().__init__("Node")
         self.app = app
@@ -90,17 +92,49 @@ class Node(BaseService):
             timeouts=timeouts,
         )
 
+        # optional real p2p stack (node/node.go:443-447 createTransport/
+        # createSwitch); when absent, `broadcast` (in-memory hub) rules
+        self.switch = None
+        self.mempool_reactor = None
+        if p2p:
+            from cometbft_tpu.consensus.reactor import ConsensusReactor
+            from cometbft_tpu.mempool.reactor import MempoolReactor
+            from cometbft_tpu.p2p.key import NodeKey
+            from cometbft_tpu.p2p.switch import Switch
+
+            nk = node_key or NodeKey.load_or_gen(
+                os.path.join(home, "node_key.json") if home else None
+            )
+            self.switch = Switch(nk, state.chain_id)
+            self.switch.add_reactor(ConsensusReactor(self.consensus))
+            self.mempool_reactor = MempoolReactor(self.mempool)
+            self.switch.add_reactor(self.mempool_reactor)
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the p2p listener; returns our NetAddress."""
+        return self.switch.listen(host, port)
+
+    def dial(self, addr, persistent: bool = True) -> None:
+        self.switch.dial_peer(addr, persistent=persistent)
+
     def on_start(self) -> None:
+        if self.switch is not None:
+            self.switch.start()
         self.consensus.start()
 
     def on_stop(self) -> None:
         self.consensus.stop()
+        if self.switch is not None:
+            self.switch.stop()
         self.block_store.close()
         self.state_store.close()
 
     # convenience API (rpc/core analogs; the JSON-RPC server wraps these)
     def broadcast_tx(self, tx: bytes) -> abci.ResponseCheckTx:
-        return self.mempool.check_tx(tx)
+        resp = self.mempool.check_tx(tx)
+        if resp.code == abci.CODE_TYPE_OK and self.mempool_reactor:
+            self.mempool_reactor.broadcast_tx(tx)
+        return resp
 
     def height(self) -> int:
         return self.consensus.state.last_block_height

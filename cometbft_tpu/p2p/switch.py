@@ -1,0 +1,202 @@
+"""Switch: peer lifecycle + reactor registry + broadcast.
+
+Reference: p2p/switch.go — AddReactor wires channel IDs to reactors
+(:86-101), addPeer attaches the peer to every reactor (:711), Broadcast
+(:280), StopPeerForError (:338), DialPeersAsync with persistent-peer
+redial. The Peer here owns one MConnection over the upgraded secret
+connection (p2p/peer.go).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.key import NetAddress, NodeInfo, NodeKey
+from cometbft_tpu.p2p.transport import Transport, UpgradedConn
+
+_log = logging.getLogger(__name__)
+
+
+class Reactor:
+    """Base reactor (p2p/base_reactor.go). Subclasses declare
+    channel_descriptors() and handle receive()."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: Optional["Switch"] = None
+
+    def channel_descriptors(self) -> List[ChannelDescriptor]:
+        return []
+
+    def add_peer(self, peer: "Peer") -> None:
+        pass
+
+    def remove_peer(self, peer: "Peer", reason: str) -> None:
+        pass
+
+    def receive(self, chan_id: int, peer: "Peer", msg: bytes) -> None:
+        pass
+
+
+class Peer:
+    """One connected peer: identity + its multiplexed connection."""
+
+    def __init__(self, sw: "Switch", up: UpgradedConn,
+                 channels: List[ChannelDescriptor]):
+        self.switch = sw
+        self.node_info = up.node_info
+        self.peer_id = up.node_info.node_id
+        self.outbound = up.outbound
+        self.remote_addr = up.remote_addr
+        self.mconn = MConnection(
+            up.sconn, channels,
+            on_receive=self._on_receive,
+            on_error=self._on_error,
+        )
+        self._data: Dict[str, object] = {}  # reactor scratch (PeerState)
+
+    def start(self) -> None:
+        self.mconn.start()
+
+    def stop(self) -> None:
+        self.mconn.stop()
+
+    def send(self, chan_id: int, msg: bytes) -> bool:
+        return self.mconn.send(chan_id, msg, block=False)
+
+    def set(self, key: str, val) -> None:
+        self._data[key] = val
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    def _on_receive(self, chan_id: int, msg: bytes) -> None:
+        reactor = self.switch.reactor_by_channel.get(chan_id)
+        if reactor is not None:
+            reactor.receive(chan_id, self, msg)
+
+    def _on_error(self, e: Exception) -> None:
+        self.switch.stop_peer_for_error(self, str(e))
+
+
+class Switch(BaseService):
+    def __init__(self, node_key: NodeKey, network: str,
+                 moniker: str = "node"):
+        super().__init__("Switch")
+        self.node_key = node_key
+        self.reactors: Dict[str, Reactor] = {}
+        self.reactor_by_channel: Dict[int, Reactor] = {}
+        self.channel_descs: List[ChannelDescriptor] = []
+        self.peers: Dict[str, Peer] = {}
+        self._peers_lock = threading.Lock()
+        self.persistent: Dict[str, NetAddress] = {}
+        self.node_info = NodeInfo(
+            node_id=node_key.node_id, network=network, moniker=moniker,
+        )
+        self.transport = Transport(node_key, self.node_info, self._on_conn)
+        self.listen_addr: Optional[NetAddress] = None
+        self._redial_thread: Optional[threading.Thread] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_reactor(self, reactor: Reactor) -> None:
+        """AddReactor (switch.go:86): channel IDs must be unique."""
+        for d in reactor.channel_descriptors():
+            if d.chan_id in self.reactor_by_channel:
+                raise ValueError(f"channel {d.chan_id} already claimed")
+            self.reactor_by_channel[d.chan_id] = reactor
+            self.channel_descs.append(d)
+            self.node_info.channels.append(d.chan_id)
+        self.reactors[reactor.name] = reactor
+        reactor.switch = self
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> NetAddress:
+        self.listen_addr = self.transport.listen(host, port)
+        return self.listen_addr
+
+    def on_start(self) -> None:
+        self._redial_thread = threading.Thread(
+            target=self._redial_loop, daemon=True, name="p2p-redial"
+        )
+        self._redial_thread.start()
+
+    def on_stop(self) -> None:
+        self.transport.close()
+        with self._peers_lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.stop()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def _on_conn(self, up: UpgradedConn) -> None:
+        peer = Peer(self, up, self.channel_descs)
+        with self._peers_lock:
+            if peer.peer_id in self.peers or \
+                    peer.peer_id == self.node_key.node_id:
+                peer.mconn.conn._stream.close()
+                return
+            self.peers[peer.peer_id] = peer
+        peer.start()
+        for r in self.reactors.values():
+            r.add_peer(peer)
+        _log.info("peer %s connected (%s)", peer.peer_id[:12],
+                  "out" if peer.outbound else "in")
+
+    def dial_peer(self, addr: NetAddress, persistent: bool = False) -> None:
+        if persistent:
+            self.persistent[addr.node_id] = addr
+        with self._peers_lock:
+            if addr.node_id in self.peers:
+                return
+        try:
+            self.transport.dial(addr)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("dial %s failed: %s", addr, e)
+
+    def dial_peers_async(self, addrs: List[NetAddress],
+                         persistent: bool = True) -> None:
+        for a in addrs:
+            threading.Thread(
+                target=self.dial_peer, args=(a, persistent), daemon=True
+            ).start()
+
+    def stop_peer_for_error(self, peer: Peer, reason: str) -> None:
+        """switch.go:338 StopPeerForError; persistent peers get redialed
+        by the redial loop."""
+        with self._peers_lock:
+            if self.peers.get(peer.peer_id) is not peer:
+                return
+            del self.peers[peer.peer_id]
+        peer.stop()
+        for r in self.reactors.values():
+            r.remove_peer(peer, reason)
+        _log.info("peer %s stopped: %s", peer.peer_id[:12], reason)
+
+    def _redial_loop(self) -> None:
+        while self.is_running():
+            for node_id, addr in list(self.persistent.items()):
+                with self._peers_lock:
+                    have = node_id in self.peers
+                if not have:
+                    try:
+                        self.transport.dial(addr)
+                    except Exception:  # noqa: BLE001
+                        pass
+            time.sleep(0.5)
+
+    # -- messaging ---------------------------------------------------------
+
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
+        with self._peers_lock:
+            peers = list(self.peers.values())
+        for p in peers:
+            p.send(chan_id, msg)
+
+    def num_peers(self) -> int:
+        with self._peers_lock:
+            return len(self.peers)

@@ -1,0 +1,178 @@
+"""P2P: secret connection (auth + tamper), MConnection multiplexing,
+switch peer lifecycle, and 4 validators reaching consensus over real TCP.
+
+Mirrors p2p/conn/secret_connection_test.go, connection_test.go, and
+switch_test.go case structure.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.conn.secret_connection import (
+    HandshakeError,
+    SecretConnection,
+)
+from cometbft_tpu.p2p.key import NetAddress, NodeKey
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+FAST = TimeoutParams(propose=0.5, propose_delta=0.2, prevote=0.3,
+                     prevote_delta=0.1, precommit=0.3, precommit_delta=0.1,
+                     commit=0.02)
+
+
+def socket_pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def handshake_pair():
+    pa, pb = PrivKey.generate(b"\x01" * 32), PrivKey.generate(b"\x02" * 32)
+    sa, sb = socket_pair()
+    out = {}
+
+    def side(name, sock, priv):
+        out[name] = SecretConnection.handshake(sock, priv)
+
+    ta = threading.Thread(target=side, args=("a", sa, pa))
+    tb = threading.Thread(target=side, args=("b", sb, pb))
+    ta.start(); tb.start(); ta.join(5); tb.join(5)
+    return out["a"], out["b"], pa, pb
+
+
+def test_secret_connection_roundtrip():
+    ca, cb, pa, pb = handshake_pair()
+    # mutual identity authentication
+    assert ca.remote_pub.data == pb.pub_key().data
+    assert cb.remote_pub.data == pa.pub_key().data
+    ca.write_msg(b"hello")
+    assert cb.read_msg() == b"hello"
+    big = bytes(range(256)) * 40  # > 1 frame, exact-multiple edge nearby
+    cb.write_msg(big)
+    assert ca.read_msg() == big
+    # exact multiple of the frame size
+    exact = b"x" * 2048
+    ca.write_msg(exact)
+    assert cb.read_msg() == exact
+
+
+def test_secret_connection_tamper_rejected():
+    ca, cb, _, _ = handshake_pair()
+    raw = ca._stream
+    # bypass the cipher and inject garbage: reader must error, not yield
+    raw.sendall(b"\x00" * (1028 + 16))
+    with pytest.raises(Exception):
+        cb.read_msg()
+
+
+def test_mconnection_multiplex_and_priority():
+    ca, cb, _, _ = handshake_pair()
+    got = []
+    done = threading.Event()
+
+    def on_recv(chan, msg):
+        got.append((chan, msg))
+        if len(got) == 3:
+            done.set()
+
+    descs = [ChannelDescriptor(1, priority=1),
+             ChannelDescriptor(2, priority=10)]
+    ma = MConnection(ca, descs, on_receive=lambda c, m: None)
+    mb = MConnection(cb, descs, on_receive=on_recv)
+    ma.start(); mb.start()
+    try:
+        assert ma.send(1, b"low")
+        assert ma.send(2, b"high-1")
+        assert ma.send(2, b"h" * 5000)  # multi-packet message
+        assert done.wait(5)
+        assert sorted(m for _, m in got) == sorted(
+            [b"low", b"high-1", b"h" * 5000]
+        )
+        chans = {c for c, _ in got}
+        assert chans == {1, 2}
+    finally:
+        ma.stop(); mb.stop()
+
+
+def test_switch_connect_and_stop_peer():
+    ka, kb = NodeKey(PrivKey.generate(b"\x0a" * 32)), \
+        NodeKey(PrivKey.generate(b"\x0b" * 32))
+    sa, sb = Switch(ka, "net-1"), Switch(kb, "net-1")
+    from cometbft_tpu.p2p.switch import Reactor
+
+    class Echo(Reactor):
+        def __init__(self):
+            super().__init__("ECHO")
+            self.got = []
+
+        def channel_descriptors(self):
+            return [ChannelDescriptor(0x7F)]
+
+        def receive(self, chan_id, peer, msg):
+            self.got.append(msg)
+
+    ea, eb = Echo(), Echo()
+    sa.add_reactor(ea); sb.add_reactor(eb)
+    addr_a = sa.listen()
+    sa.start(); sb.start()
+    try:
+        sb.dial_peer(addr_a, persistent=False)
+        deadline = time.time() + 5
+        while (sa.num_peers() < 1 or sb.num_peers() < 1):
+            assert time.time() < deadline, "peers never connected"
+            time.sleep(0.02)
+        sb.broadcast(0x7F, b"ping-from-b")
+        deadline = time.time() + 5
+        while not ea.got:
+            assert time.time() < deadline, "message never arrived"
+            time.sleep(0.02)
+        assert ea.got == [b"ping-from-b"]
+        # identity mismatch: dialing a wrong ID must fail to add a peer
+        bad = NetAddress("ff" * 20, addr_a.host, addr_a.port)
+        sb.dial_peer(bad, persistent=False)
+        time.sleep(0.3)
+        assert sb.num_peers() == 1
+    finally:
+        sa.stop(); sb.stop()
+
+
+def test_four_validators_over_tcp(tmp_path):
+    """BASELINE config #1 topology over the real transport: 4 nodes, TCP
+    localhost mesh, all reach height 4 and agree."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis("tcp-chain", vals)
+    nodes, addrs = [], []
+    for i, priv in enumerate(privs):
+        n = Node(KVStoreApplication(), state.copy(), privval=FilePV(priv),
+                 home=str(tmp_path / f"n{i}"), timeouts=FAST, p2p=True,
+                 node_key=NodeKey(PrivKey.generate(bytes([0x40 + i]) * 32)))
+        addrs.append(n.listen())
+        nodes.append(n)
+    for n in nodes:
+        n.start()
+    try:
+        # full mesh
+        for i, n in enumerate(nodes):
+            for j, a in enumerate(addrs):
+                if i != j:
+                    n.dial(a)
+        nodes[0].broadcast_tx(b"tcp=yes")
+        for n in nodes:
+            assert n.consensus.wait_for_height(4, timeout=90), \
+                f"stuck at {n.height()}"
+        assert all(n.query(b"tcp").value == b"yes" for n in nodes)
+        h2 = {n.block_store.load_block(2).hash() for n in nodes}
+        assert len(h2) == 1
+    finally:
+        for n in nodes:
+            n.stop()
