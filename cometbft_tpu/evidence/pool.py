@@ -1,0 +1,135 @@
+"""Evidence pool: pending/committed evidence with expiry and block
+prioritization.
+
+Reference: evidence/pool.go — AddEvidence (:136: verify, dedupe, persist
+pending), CheckEvidence (:192: verify proposed-block evidence, reject
+committed/expired), PendingEvidence (:87: prioritized for inclusion up to
+maxBytes), MarkEvidenceAsCommitted (:110), expiry by age in both height
+and time (consensus params EvidenceParams).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.evidence.verify import verify_duplicate_vote
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+)
+
+# consensus params defaults (types/params.go EvidenceParams)
+MAX_AGE_NUM_BLOCKS = 100_000
+MAX_AGE_SECONDS = 48 * 3600
+
+
+class EvidencePool:
+    def __init__(
+        self,
+        chain_id: str,
+        load_validators: Callable[[int], Optional[object]],
+        max_age_blocks: int = MAX_AGE_NUM_BLOCKS,
+        max_age_seconds: float = MAX_AGE_SECONDS,
+    ):
+        """load_validators(height) -> ValidatorSet at that height (the
+        state store's LoadValidators seam)."""
+        self.chain_id = chain_id
+        self.load_validators = load_validators
+        self.max_age_blocks = max_age_blocks
+        self.max_age_seconds = max_age_seconds
+        self._pending: Dict[bytes, DuplicateVoteEvidence] = {}
+        self._committed: set = set()
+        self._lock = threading.Lock()
+        self.height = 0  # latest committed block height
+        self.time_s = 0  # latest committed block time (seconds)
+
+    # -- intake --------------------------------------------------------------
+
+    def add_evidence(self, ev: DuplicateVoteEvidence) -> bool:
+        """AddEvidence (pool.go:136): verify then persist pending.
+        Returns False (no raise) for duplicates/committed/expired."""
+        key = ev.hash()
+        with self._lock:
+            if key in self._pending or key in self._committed:
+                return False
+            if self._expired_locked(ev):
+                return False
+        vals = self.load_validators(ev.height)
+        if vals is None:
+            raise EvidenceError(f"no validator set for height {ev.height}")
+        verify_duplicate_vote(ev, self.chain_id, vals)
+        with self._lock:
+            self._pending[key] = ev
+        return True
+
+    def check_evidence(self, evs: List[DuplicateVoteEvidence]) -> None:
+        """CheckEvidence (pool.go:192): every item of a proposed block
+        must verify and be neither committed nor expired; raises on the
+        first offender."""
+        seen = set()
+        for ev in evs:
+            key = ev.hash()
+            if key in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(key)
+            with self._lock:
+                if key in self._committed:
+                    raise EvidenceError("evidence already committed")
+                if self._expired_locked(ev):
+                    raise EvidenceError("evidence expired")
+                known = key in self._pending
+            if not known:
+                vals = self.load_validators(ev.height)
+                if vals is None:
+                    raise EvidenceError(
+                        f"no validator set for height {ev.height}"
+                    )
+                verify_duplicate_vote(ev, self.chain_id, vals)
+
+    # -- consumption ---------------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int = -1
+                         ) -> List[DuplicateVoteEvidence]:
+        """PendingEvidence (pool.go:87): oldest-first up to max_bytes."""
+        with self._lock:
+            evs = sorted(self._pending.values(), key=lambda e: e.height)
+        out, total = [], 0
+        for ev in evs:
+            sz = len(ev.bytes())
+            if max_bytes >= 0 and total + sz > max_bytes:
+                break
+            out.append(ev)
+            total += sz
+        return out
+
+    def mark_committed(self, height: int, time_s: int,
+                       evs: List[DuplicateVoteEvidence]) -> None:
+        """MarkEvidenceAsCommitted + Update (pool.go:110): drop from
+        pending, remember committed, advance the expiry frontier."""
+        with self._lock:
+            self.height = height
+            self.time_s = time_s
+            for ev in evs:
+                key = ev.hash()
+                self._committed.add(key)
+                self._pending.pop(key, None)
+            # prune expired pending
+            for key in [k for k, e in self._pending.items()
+                        if self._expired_locked(e)]:
+                del self._pending[key]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- expiry ----------------------------------------------------------------
+
+    def _expired_locked(self, ev) -> bool:
+        """Evidence is expired only when BOTH age bounds are exceeded
+        (pool.go isExpired: height AND time)."""
+        if self.height == 0:
+            return False
+        age_blocks = self.height - ev.height
+        age_seconds = self.time_s - ev.timestamp.seconds
+        return (age_blocks > self.max_age_blocks
+                and age_seconds > self.max_age_seconds)

@@ -1,0 +1,77 @@
+"""Evidence verification.
+
+Reference: evidence/verify.go — VerifyDuplicateVote (:166: votes well-
+formed + conflicting, validator was in the set at that height, powers
+match the historical snapshot, both signatures valid),
+VerifyLightClientAttack (:110: common-height commit still trusted via
+VerifyCommitLightTrusting, conflicting header sealed by VerifyCommitLight
+— both riding the batched device verifier).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+    LightClientAttackEvidence,
+)
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import VoteError
+
+
+def verify_duplicate_vote(
+    ev: DuplicateVoteEvidence,
+    chain_id: str,
+    vals: ValidatorSet,
+) -> None:
+    """evidence/verify.go:166. `vals` is the validator set AT the evidence
+    height (state store LoadValidators)."""
+    ev.validate_basic()
+    _, val = vals.get_by_address(ev.vote_a.validator_address)
+    if val is None:
+        raise EvidenceError(
+            f"validator {ev.vote_a.validator_address.hex()} not in set at "
+            f"height {ev.height}"
+        )
+    # power snapshots must match the historical set (verify.go:203-215)
+    if ev.validator_power != val.voting_power:
+        raise EvidenceError(
+            f"validator power mismatch: evidence {ev.validator_power}, "
+            f"set {val.voting_power}"
+        )
+    if ev.total_voting_power != vals.total_voting_power():
+        raise EvidenceError(
+            f"total power mismatch: evidence {ev.total_voting_power}, "
+            f"set {vals.total_voting_power()}"
+        )
+    try:
+        ev.vote_a.verify(chain_id, val.pub_key)
+        ev.vote_b.verify(chain_id, val.pub_key)
+    except VoteError as e:
+        raise EvidenceError(f"invalid signature on evidence vote: {e}")
+
+
+def verify_light_client_attack(
+    ev: LightClientAttackEvidence,
+    chain_id: str,
+    common_vals: ValidatorSet,
+    conflicting_commit,
+    conflicting_vals: Optional[ValidatorSet] = None,
+    trust_level=(1, 3),
+    batch_fn: Optional[Callable] = None,
+) -> None:
+    """evidence/verify.go:110: the conflicting header must be sealed by
+    (a) >=1/3 of the common-height set (VerifyCommitLightTrusting,
+    :123) and (b) 2/3+ of its own claimed set (VerifyCommitLight, :135)."""
+    from cometbft_tpu.types import validation
+
+    ev.validate_basic()
+    validation.verify_commit_light_trusting(
+        chain_id, common_vals, conflicting_commit, trust_level, batch_fn,
+    )
+    if conflicting_vals is not None:
+        validation.verify_commit_light(
+            chain_id, conflicting_vals, conflicting_commit.block_id,
+            conflicting_commit.height, conflicting_commit, batch_fn,
+        )
