@@ -64,9 +64,14 @@ class StreamVerifier:
     the reference's per-sig blame fallback, types/validation.go:243-250).
     """
 
-    def __init__(self, max_sigs: int = 16384, use_pallas: bool = False):
+    def __init__(self, max_sigs: int = 16384, use_pallas: bool = False,
+                 min_device_sigs: int = 129):
         self.max_sigs = max_sigs
         self.use_pallas = use_pallas
+        # below this many rows the device pass loses to a host verify
+        # loop (dispatch + compile economics — the shouldBatchVerify gate,
+        # types/validation.go:13-17, applied to the streaming path)
+        self.min_device_sigs = min_device_sigs
 
     # -- packing -----------------------------------------------------------
 
@@ -130,9 +135,9 @@ class StreamVerifier:
         if self.use_pallas:
             from cometbft_tpu.ops import ed25519_pallas as kp
 
-            return kp.verify_tally_pallas(
-                *kp.pack_transposed(pb), power5, counted, commit_ids, thresh
-            )
+            # single fused H2D transfer per chunk (see kp.pack_rows)
+            rows = kp.pack_rows(pb, power5, counted, commit_ids, thresh)
+            return kp.verify_tally_rows(rows, thresh.shape[0])
         return ek.verify_tally_kernel(
             pb.ay, pb.asign, pb.ry, pb.rsign, pb.sdig, pb.hdig, pb.precheck,
             power5, counted, commit_ids, thresh, n_commits,
@@ -188,6 +193,22 @@ class StreamVerifier:
                 done.add(i)
 
         indexed = [(i, j) for i, j in enumerate(jobs) if i not in done]
+        total_rows = sum(
+            len(j.commit.signatures) for _, j in indexed
+        )
+        if total_rows < self.min_device_sigs:
+            from cometbft_tpu.types import validation as tv
+
+            for gi, job in indexed:
+                try:
+                    tv.verify_commit_light(
+                        job.chain_id, job.vals, job.block_id, job.height,
+                        job.commit, batch_fn=None,
+                    )
+                except VerificationError as e:
+                    results[gi] = e
+            return results
+
         in_flight: List[_Chunk] = []
         for chunk_pairs in self._chunk_indexed(indexed):
             chunk = self._pack_chunk(chunk_pairs)
@@ -226,7 +247,7 @@ class StreamVerifier:
 def make_stream_verifier(use_pallas: Optional[bool] = None,
                          max_sigs: int = 16384) -> StreamVerifier:
     if use_pallas is None:
-        import jax
+        from cometbft_tpu.crypto.batch import _accel_backend
 
-        use_pallas = jax.default_backend() not in ("cpu",)
+        use_pallas = _accel_backend()
     return StreamVerifier(max_sigs=max_sigs, use_pallas=use_pallas)

@@ -24,6 +24,8 @@ Reference seam (same as ops.ed25519_kernel): crypto/ed25519/ed25519.go:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -149,15 +151,51 @@ def decompress(y, sign_row, d_col, sqrt_m1_col):
 # --------------------------------------------------------------------------
 
 
-def _kernel(ay_ref, asign_ref, ry_ref, rsign_ref, sdig_ref, hdig_ref,
-            pre_ref, base_ref, valid_ref):
+# Compact packed-row layout. Every per-signature device input rides ONE
+# int32 array (rows, B): limbs are packed two-per-word, scalar digits
+# byte/nibble-packed, flags bit-packed. 42 rows = 168 B/signature, vs 179
+# unpacked rows (716 B/sig) — H2D transfer volume is usually the streaming
+# bottleneck (tunnel or DCN), and unpacking is a handful of VPU shifts.
+C_AY = 0        # 10 rows: pubkey y limb pairs, word = l[i] | l[i+10] << 13
+C_RY = 10       # 10 rows: sig R y limb pairs
+C_S8 = 20       # 8 rows: byte digits of s (comb), digit d at row d%8
+C_H4 = 28       # 8 rows: nibble digits of h, digit d at row d%8
+C_FLAGS = 36    # asign | rsign<<1 | precheck<<2 | counted<<3
+C_KROWS = 37    # kernel block height (rows below are tally-side only)
+C_POW = 37      # 3 rows: p0|p1<<13, p2|p3<<13, p4
+C_CID = 40      # commit id per signature row
+C_THRESH = 41   # flattened (n_commits, TALLY_LIMBS) thresholds
+_M13 = (1 << 13) - 1
+
+
+def _kernel(packed_ref, base_ref, valid_ref, s8_ref, h4_ref):
     b = B_TILE
     d_col = const_col(_D_T, b)
     d2_col = const_col(_D2_T, b)
     sqrt_m1_col = const_col(_SQRT_M1_T, b)
 
-    A, ok_a = decompress(ay_ref[:, :], asign_ref[:, :], d_col, sqrt_m1_col)
-    R, ok_r = decompress(ry_ref[:, :], rsign_ref[:, :], d_col, sqrt_m1_col)
+    pk = packed_ref[:, :]  # (C_KROWS, b)
+    ay2 = pk[C_AY:C_AY + 10]
+    ay = jnp.concatenate([ay2 & _M13, ay2 >> 13], axis=0)
+    ry2 = pk[C_RY:C_RY + 10]
+    ry = jnp.concatenate([ry2 & _M13, ry2 >> 13], axis=0)
+    # digits go to VMEM scratch: the window loops index them with a
+    # dynamic pl.ds, which Mosaic supports on refs but not on values
+    s8p = pk[C_S8:C_S8 + 8]
+    s8_ref[:, :] = jnp.concatenate(
+        [(s8p >> (8 * k)) & 255 for k in range(4)], axis=0
+    )  # (32, b) byte digits
+    h4p = pk[C_H4:C_H4 + 8]
+    h4_ref[:, :] = jnp.concatenate(
+        [(h4p >> (4 * k)) & 15 for k in range(8)], axis=0
+    )  # (64, b) nibble digits
+    flags = pk[C_FLAGS:C_FLAGS + 1]
+    asign = flags & 1
+    rsign = (flags >> 1) & 1
+    pre = (flags >> 2) & 1
+
+    A, ok_a = decompress(ay, asign, d_col, sqrt_m1_col)
+    R, ok_r = decompress(ry, rsign, d_col, sqrt_m1_col)
     negA = pt_neg(A)
 
     # per-signature table entries [d](-A), d in 0..15 — statically unrolled,
@@ -183,21 +221,19 @@ def _kernel(ay_ref, asign_ref, ry_ref, rsign_ref, sdig_ref, hdig_ref,
     def win_body(i, pt):
         w = 62 - i
         pt = pt_double(pt_double_p(pt_double_p(pt_double_p(pt))))
-        d_row = hdig_ref[pl.ds(w, 1), :]
+        d_row = h4_ref[pl.ds(w, 1), :]
         return pt_add(pt, lookup(d_row), d2_col)
 
-    h_negA = jax.lax.fori_loop(
-        0, 63, win_body, lookup(hdig_ref[63:64, :])
-    )
+    h_negA = jax.lax.fori_loop(0, 63, win_body, lookup(h4_ref[63:64, :]))
 
-    # [S]B comb: 64 windows, each an f32 one-hot matmul on the MXU.
-    # base_ref rows are (window*16 + digit) -> flattened point (4*NLIMBS,)
-    iota16 = jax.lax.broadcasted_iota(jnp.int32, (16, b), 0)
+    # [S]B comb: 32 width-8 windows, each an f32 one-hot matmul on the MXU.
+    # base_ref rows are (window*256 + digit) -> flattened point (4*NLIMBS,)
+    iota256 = jax.lax.broadcasted_iota(jnp.int32, (256, b), 0)
 
     def base_body(w, pt):
-        d_row = sdig_ref[pl.ds(w, 1), :]
-        oh = (iota16 == d_row).astype(jnp.float32)  # (16, B)
-        t_w = base_ref[pl.ds(w * 16, 16), :]  # (16, 80) f32
+        d8 = s8_ref[pl.ds(w, 1), :]
+        oh = (iota256 == d8).astype(jnp.float32)  # (256, B)
+        t_w = base_ref[pl.ds(w * 256, 256), :]  # (256, 80) f32
         ent = jax.lax.dot_general(
             t_w, oh, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -208,72 +244,80 @@ def _kernel(ay_ref, asign_ref, ry_ref, rsign_ref, sdig_ref, hdig_ref,
         e = ent.reshape(4, NLIMBS, b)
         return pt_add(pt, (e[0], e[1], e[2], e[3]), d2_col)
 
-    sB = jax.lax.fori_loop(0, 64, base_body, pt_identity(b))
+    sB = jax.lax.fori_loop(0, 32, base_body, pt_identity(b))
 
     W = pt_add_noT(pt_add(sB, h_negA, d2_col), pt_neg(R), d2_col)
     W8 = pt_double_p(pt_double_p(pt_double_p(W)))
     eq = F.is_zero(W8[0]) & F.eq(W8[1], W8[2])  # (1, B)
-    valid = eq & ok_a & ok_r & (pre_ref[:, :] != 0)
+    valid = eq & ok_a & ok_r & (pre != 0)
     valid_ref[:, :] = valid.astype(jnp.int32)
 
 
 _BASE_F32 = None
+_BASE_DEV = None
+
+
+def base_dev():
+    """Device-resident base comb table, uploaded once per process.
+
+    jnp.asarray(base_f32()) at every call site re-transferred the 2.6 MB
+    table per verify (~40 ms on the axon tunnel); the table is immutable,
+    so pin it once.
+    """
+    global _BASE_DEV
+    if _BASE_DEV is None:
+        import jax as _jax
+
+        _BASE_DEV = _jax.device_put(base_f32())
+    return _BASE_DEV
 
 
 def base_f32() -> np.ndarray:
-    """Base comb table as (64*16, 4*NLIMBS) float32; rows indexed by
-    window*16 + digit. Built eagerly from the numpy table — never inside
+    """Base comb table as (32*256, 4*NLIMBS) float32; rows indexed by
+    window*256 + digit. Built eagerly from the numpy table — never inside
     a trace (round-1 bug: jnp base_table() under jit raised
     TracerArrayConversionError)."""
     global _BASE_F32
     if _BASE_F32 is None:
-        t = curve_hl.base_table_np()  # numpy (64, 16, 4, NLIMBS)
+        t = curve_hl.base_table8_np()  # numpy (32, 256, 4, NLIMBS)
         _BASE_F32 = np.ascontiguousarray(
-            t.reshape(64 * 16, 4 * NLIMBS)
+            t.reshape(32 * 256, 4 * NLIMBS)
         ).astype(np.float32)
     return _BASE_F32
 
 
 @jax.jit
-def _verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base):
-    """Fused verify over limbs-first arrays.
+def _verify_rows(rows, base):
+    """Fused verify over a compact packed array (>= C_KROWS rows, B).
 
-    ay_t/ry_t: (NLIMBS, B); asign/rsign/precheck: (1, B); sdig_t/hdig_t:
-    (64, B); base: (1024, 80) f32. B must be a multiple of B_TILE.
-    Returns (B,) bool.
+    B must be a multiple of B_TILE. Returns (B,) bool.
     """
-    B = ay_t.shape[1]
+    B = rows.shape[1]
     assert B % B_TILE == 0, f"B={B} not a multiple of {B_TILE}"
     grid = (B // B_TILE,)
     col = lambda r: pl.BlockSpec(
         (r, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     full = pl.BlockSpec(
-        (64 * 16, 4 * NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM
+        (32 * 256, 4 * NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM
     )
     out = pl.pallas_call(
         _kernel,
         interpret=(jax.default_backend() == "cpu"),
         out_shape=jax.ShapeDtypeStruct((1, B), jnp.int32),
         grid=grid,
-        in_specs=[col(NLIMBS), col(1), col(NLIMBS), col(1), col(64),
-                  col(64), col(1), full],
+        in_specs=[col(C_KROWS), full],
         out_specs=col(1),
-    )(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base)
+        scratch_shapes=[
+            pltpu.VMEM((32, B_TILE), jnp.int32),  # s8 byte digits
+            pltpu.VMEM((64, B_TILE), jnp.int32),  # h4 nibble digits
+        ],
+    )(rows[:C_KROWS], base)
     return out[0] != 0
 
 
-def verify_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck):
-    """Public entry: supplies the base comb table (built outside any trace)."""
-    return _verify_pallas(
-        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
-        jnp.asarray(base_f32()),
-    )
-
-
-@jax.jit
-def _verify_tally_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
-                         base, power5, counted, commit_ids, threshold):
+@functools.partial(jax.jit, static_argnums=(2,))
+def _verify_tally_rows(rows, base, n_commits: int):
     """Pallas verify + fused XLA tally/quorum in one compiled program.
 
     The tally is one one-hot einsum + carry chain (ed25519_kernel.tally_core)
@@ -281,20 +325,86 @@ def _verify_tally_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
     same jit rather than the Mosaic kernel."""
     from cometbft_tpu.ops import ed25519_kernel as ek
 
-    valid = _verify_pallas.__wrapped__(
-        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck, base
-    )
-    n_commits = threshold.shape[0]
+    valid = _verify_rows.__wrapped__(rows, base)
+    pw = rows[C_POW:C_POW + 3]
+    power5 = jnp.stack(
+        [pw[0] & _M13, pw[0] >> 13, pw[1] & _M13, pw[1] >> 13, pw[2]],
+        axis=1,
+    )  # (B, POWER_LIMBS)
+    counted = (rows[C_FLAGS] >> 3) & 1 != 0
+    commit_ids = rows[C_CID]
+    thresh = rows[C_THRESH:].reshape(-1)[
+        : n_commits * ek.TALLY_LIMBS
+    ].reshape(n_commits, ek.TALLY_LIMBS)
     tally = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
-    return valid, tally, ek.quorum_core(tally, threshold)
+    return valid, tally, ek.quorum_core(tally, thresh)
 
 
-def verify_tally_pallas(ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
-                        power5, counted, commit_ids, threshold):
-    return _verify_tally_pallas(
-        ay_t, asign, ry_t, rsign, sdig_t, hdig_t, precheck,
-        jnp.asarray(base_f32()), power5, counted, commit_ids, threshold,
-    )
+def pack_rows(pb, power5=None, counted=None, commit_ids=None,
+              thresh=None) -> np.ndarray:
+    """Pack a PackedBatch (+ optional tally metadata) into one compact
+    (R, B) int32 array — exactly one H2D transfer per batch. Round 2
+    shipped 11 separate device_puts (~2.8 s of tunnel round trips for
+    7 MB); this is 42 rows = 168 B/signature, one transfer.
+    """
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    B = pb.ay.shape[0]
+    if thresh is None:
+        thresh = np.zeros((1, ek.TALLY_LIMBS), np.int32)
+    tvals = np.asarray(thresh, np.int32).reshape(-1)
+    t_rows = max(1, -(-tvals.size // B))
+    rows = np.zeros((C_THRESH + t_rows, B), np.int32)
+    ay = np.asarray(pb.ay, np.int32)
+    ry = np.asarray(pb.ry, np.int32)
+    rows[C_AY:C_AY + 10] = (ay[:, :10] | (ay[:, 10:] << 13)).T
+    rows[C_RY:C_RY + 10] = (ry[:, :10] | (ry[:, 10:] << 13)).T
+    s8 = (pb.sdig[:, 0::2] + 16 * pb.sdig[:, 1::2]).astype(np.int32)  # (B,32)
+    acc = np.zeros((B, 8), np.int32)
+    for k in range(4):
+        acc |= s8[:, 8 * k:8 * k + 8] << (8 * k)
+    rows[C_S8:C_S8 + 8] = acc.T
+    acc = np.zeros((B, 8), np.int32)
+    h4 = np.asarray(pb.hdig, np.int32)
+    for k in range(8):
+        acc |= h4[:, 8 * k:8 * k + 8] << (4 * k)
+    rows[C_H4:C_H4 + 8] = acc.T
+    flags = (pb.asign.astype(np.int32)
+             | (pb.rsign.astype(np.int32) << 1)
+             | (pb.precheck.astype(np.int32) << 2))
+    if counted is not None:
+        flags = flags | (np.asarray(counted, np.int32) << 3)
+    rows[C_FLAGS] = flags
+    if power5 is not None:
+        p = np.asarray(power5, np.int32)
+        rows[C_POW] = p[:, 0] | (p[:, 1] << 13)
+        rows[C_POW + 1] = p[:, 2] | (p[:, 3] << 13)
+        rows[C_POW + 2] = p[:, 4]
+    if commit_ids is not None:
+        rows[C_CID] = np.asarray(commit_ids, np.int32)
+    flat = rows[C_THRESH:].reshape(-1)
+    flat[: tvals.size] = tvals
+    return rows
+
+
+def verify_rows(rows):
+    """(R, B) packed array (host or device) -> (B,) bool validity."""
+    return _verify_rows(rows, base_dev())
+
+
+def verify_tally_rows(rows, n_commits: int):
+    """Fused verify+tally from one packed (R, B) int32 array (host or
+    device). One upload, one compiled program, three outputs."""
+    return _verify_tally_rows(rows, base_dev(), n_commits)
+
+
+class _PB:
+    """Duck-typed PackedBatch view over pre-split arrays (used by
+    ops.sr25519_kernel to reuse pack_rows for schnorrkel rows)."""
+
+    def __init__(self, ay, asign, ry, rsign, sdig, hdig, precheck):
+        self.ay, self.asign, self.ry, self.rsign = ay, asign, ry, rsign
+        self.sdig, self.hdig, self.precheck = sdig, hdig, precheck
 
 
 def pad_to_tile(n: int) -> int:
@@ -305,23 +415,9 @@ def pad_to_tile(n: int) -> int:
     return max(b, B_TILE)
 
 
-def pack_transposed(pb):
-    """PackedBatch (batch-major) -> limbs-first device arrays."""
-    return (
-        np.ascontiguousarray(pb.ay.T),
-        pb.asign[None, :].astype(np.int32),
-        np.ascontiguousarray(pb.ry.T),
-        pb.rsign[None, :].astype(np.int32),
-        np.ascontiguousarray(pb.sdig.T),
-        np.ascontiguousarray(pb.hdig.T),
-        pb.precheck[None, :].astype(np.int32),
-    )
-
-
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     """Drop-in equivalent of ed25519_kernel.verify_batch via Pallas."""
     from cometbft_tpu.ops import ed25519_kernel as ek
 
     pb = ek.pack_batch(pubkeys, msgs, sigs, pad_to=pad_to_tile(len(pubkeys)))
-    args = pack_transposed(pb)
-    return np.asarray(verify_pallas(*args))[: pb.n]
+    return np.asarray(verify_rows(pack_rows(pb)))[: pb.n]

@@ -63,6 +63,44 @@ def sharded_verify_tally(mesh: Mesh, n_commits: int):
     return jax.jit(sharded)
 
 
+def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
+    """The FLAGSHIP (Pallas) kernel under shard_map.
+
+    The compact packed array (R, B) shards on its lane axis (axis 1): each
+    device runs the Mosaic kernel on its B/n_dev slice (which must be a
+    multiple of ed25519_pallas.B_TILE), computes its partial power tally,
+    and one psum over the mesh reduces per-commit tallies. Thresholds ride
+    as a separate replicated argument (they are per-commit, not per-row,
+    so they must not be lane-sharded with the rows)."""
+    from cometbft_tpu.ops import ed25519_pallas as kp
+
+    axis = mesh.axis_names[0]
+
+    def step(rows, base, threshold):
+        valid = kp._verify_rows.__wrapped__(rows, base)
+        pw = rows[kp.C_POW:kp.C_POW + 3]
+        power5 = jax.numpy.stack(
+            [pw[0] & kp._M13, pw[0] >> 13, pw[1] & kp._M13,
+             pw[1] >> 13, pw[2]], axis=1)
+        counted = (rows[kp.C_FLAGS] >> 3) & 1 != 0
+        commit_ids = rows[kp.C_CID]
+        local = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
+        total = _carry_tally(jax.lax.psum(local, axis))
+        quorum = ek.quorum_core(total, threshold)
+        return valid, total, quorum
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(), P()),
+        out_specs=(P(axis), P(), P()),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation;
+        # the specs above pin the sharding explicitly
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
                        commit_ids):
     """Pad batch arrays to a multiple of the mesh size and device_put them

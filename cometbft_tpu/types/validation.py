@@ -301,13 +301,11 @@ def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
     sequential; the fused device tally serves the streaming paths
     (blocksync replay) where whole commits are verified unconditionally.
     """
-    import jax
-
     from cometbft_tpu.crypto import batch as cbatch
     from cometbft_tpu.ops import ed25519_kernel as ek
 
     if use_pallas is None:
-        use_pallas = jax.default_backend() not in ("cpu",)
+        use_pallas = cbatch._accel_backend()
 
     def ed25519_verify(pub_bytes, msgs, sigs):
         n = len(pub_bytes)
@@ -316,7 +314,7 @@ def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
 
             pad = kp.pad_to_tile(n)
             pb = ek.pack_batch(pub_bytes, msgs, sigs, pad_to=pad)
-            valid = np.asarray(kp.verify_pallas(*kp.pack_transposed(pb)))
+            valid = np.asarray(kp.verify_rows(kp.pack_rows(pb)))
         else:
             pb = ek.pack_batch(pub_bytes, msgs, sigs)
             valid = np.asarray(

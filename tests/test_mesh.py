@@ -41,3 +41,46 @@ def test_sharded_matches_single_device():
     exp1 = sum(int(powers[i]) for i in range(n // 2, n))
     assert int(t[0]) == exp0 and int(t[1]) == exp1
     assert bool(quorum[0]) and bool(quorum[1])
+
+
+def test_sharded_pallas_rows():
+    """The flagship Mosaic kernel under shard_map: a 1024-row packed
+    batch lane-sharded over the 8-device mesh, per-device Pallas tiles,
+    psum tally (round-2 verdict item 7)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_pallas as kp
+
+    n_dev = len(jax.devices())
+    n = n_dev * kp.B_TILE
+    keys = [PrivKey.generate(i.to_bytes(4, "big") + b"\x19" * 28)
+            for i in range(n)]
+    pubs = [q.pub_key().data for q in keys]
+    msgs = [b"sharded-%d" % i for i in range(n)]
+    sigs = [q.sign(m) for q, m in zip(keys, msgs)]
+    sigs[7] = sigs[7][:12] + bytes([sigs[7][12] ^ 1]) + sigs[7][13:]
+    sigs[900 % n] = b"\x00" * 64
+
+    pb = k.pack_batch(pubs, msgs, sigs, pad_to=n)
+    powers = np.full((n,), 10, np.int64)
+    power5 = k.power_limbs(powers)
+    counted = np.ones((n,), np.bool_)
+    cids = np.zeros((n,), np.int32)
+    thresh = k.threshold_limbs(int(powers.sum()) * 2 // 3)
+    rows = kp.pack_rows(pb, power5, counted, cids, thresh)
+    rows[kp.C_THRESH:] = 0  # thresholds ride separately when sharded
+
+    mesh = pm.make_mesh()
+    step = pm.sharded_verify_tally_rows(mesh, n_commits=1)
+    rows_d = jax.device_put(
+        rows, NamedSharding(mesh, P(None, mesh.axis_names[0]))
+    )
+    valid, tally, quorum = jax.block_until_ready(
+        step(rows_d, kp.base_f32(), thresh)
+    )
+    exp = np.ones(n, bool)
+    exp[[7, 900 % n]] = False
+    np.testing.assert_array_equal(np.asarray(valid)[:n], exp)
+    assert k.tally_to_int(np.asarray(tally))[0] == int(powers.sum()) - 20
+    assert bool(np.asarray(quorum)[0])
