@@ -23,15 +23,28 @@ import numpy as np
 from cometbft_tpu.crypto.keys import (
     ED25519_KEY_TYPE,
     SECP256K1_KEY_TYPE,
+    SR25519_KEY_TYPE,
     PubKey,
 )
 
-_BATCHABLE = {ED25519_KEY_TYPE, SECP256K1_KEY_TYPE}
+_BATCHABLE = {ED25519_KEY_TYPE, SECP256K1_KEY_TYPE, SR25519_KEY_TYPE}
 
 
 def supports_batch_verifier(key_type: str) -> bool:
     """crypto/batch/batch.go:24-32 analog (plus secp256k1)."""
     return key_type in _BATCHABLE
+
+
+def _accel_backend() -> bool:
+    """True when an accelerator backend is actually usable. Never raises:
+    a misconfigured JAX_PLATFORMS must degrade to the CPU path, not take
+    signature verification down with it."""
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 - backend init failure
+        return False
 
 
 def _kernel_for(key_type: str) -> Callable:
@@ -40,9 +53,18 @@ def _kernel_for(key_type: str) -> Callable:
 
         return ed25519_kernel.verify_batch
     if key_type == SECP256K1_KEY_TYPE:
+        if _accel_backend():
+            from cometbft_tpu.ops import ecdsa_pallas
+
+            return ecdsa_pallas.verify_batch
+        # CPU: the XLA-composed kernel beats interpret-mode Pallas
         from cometbft_tpu.ops import ecdsa_kernel
 
         return ecdsa_kernel.verify_batch
+    if key_type == SR25519_KEY_TYPE:
+        from cometbft_tpu.ops import sr25519_kernel
+
+        return sr25519_kernel.verify_batch
     raise ValueError(f"no batch verifier for key type {key_type!r}")
 
 

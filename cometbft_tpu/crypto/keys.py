@@ -33,6 +33,7 @@ from cometbft_tpu.crypto import tmhash
 
 ED25519_KEY_TYPE = "ed25519"
 SECP256K1_KEY_TYPE = "secp256k1"
+SR25519_KEY_TYPE = "sr25519"
 
 
 @dataclass(frozen=True)
@@ -43,9 +44,9 @@ class PubKey:
     key_type: str = ED25519_KEY_TYPE
 
     def address(self) -> bytes:
-        """20-byte address: SHA256(pubkey)[:20] for ed25519
-        (crypto/crypto.go:18), RIPEMD160(SHA256(pubkey)) for secp256k1
-        (crypto/secp256k1/secp256k1.go:131)."""
+        """20-byte address: SHA256(pubkey)[:20] for ed25519 and sr25519
+        (crypto/crypto.go:18, crypto/sr25519/pubkey.go:27),
+        RIPEMD160(SHA256(pubkey)) for secp256k1 (secp256k1.go:131)."""
         if self.key_type == SECP256K1_KEY_TYPE:
             from cometbft_tpu.crypto import secp256k1_ref
 
@@ -54,11 +55,16 @@ class PubKey:
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         """Single verify: ZIP-215 for ed25519 (crypto/ed25519/ed25519.go:181),
-        low-S-enforcing ECDSA for secp256k1 (secp256k1.go:192-220)."""
+        low-S-enforcing ECDSA for secp256k1 (secp256k1.go:192-220),
+        schnorrkel for sr25519 (crypto/sr25519/pubkey.go:50)."""
         if self.key_type == SECP256K1_KEY_TYPE:
             from cometbft_tpu.crypto import secp256k1_ref
 
             return secp256k1_ref.verify(self.data, msg, sig)
+        if self.key_type == SR25519_KEY_TYPE:
+            from cometbft_tpu.crypto import sr25519_ref
+
+            return sr25519_ref.verify(self.data, msg, sig)
         if self.key_type != ED25519_KEY_TYPE:
             raise ValueError(f"unsupported key type {self.key_type!r}")
         return ed25519_ref.verify(self.data, msg, sig)
@@ -137,3 +143,35 @@ class Secp256k1PrivKey:
         from cometbft_tpu.crypto import secp256k1_ref as sref
 
         return sref.sign(self.secret, msg)
+
+
+@dataclass(frozen=True)
+class Sr25519PrivKey:
+    """An sr25519 (schnorrkel) private key: 32-byte mini-secret.
+
+    Reference: crypto/sr25519/privkey.go:27-60 (MiniSecretKey expanded
+    ExpandEd25519-style; signing over the empty-context merlin
+    transcript)."""
+
+    data: bytes  # mini-secret seed
+
+    @staticmethod
+    def generate(seed: Optional[bytes] = None) -> "Sr25519PrivKey":
+        if seed is None:
+            import os as _os
+
+            seed = _os.urandom(32)
+        assert len(seed) == 32
+        return Sr25519PrivKey(seed)
+
+    def pub_key(self) -> PubKey:
+        from cometbft_tpu.crypto import sr25519_ref
+
+        return PubKey(
+            sr25519_ref.pubkey_from_seed(self.data), SR25519_KEY_TYPE
+        )
+
+    def sign(self, msg: bytes) -> bytes:
+        from cometbft_tpu.crypto import sr25519_ref
+
+        return sr25519_ref.sign(self.data, msg)
