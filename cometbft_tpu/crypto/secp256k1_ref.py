@@ -80,9 +80,29 @@ def decompress(pub: bytes) -> Optional[Tuple[int, int]]:
     return x, y
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=16384)
+def _derived_key(d: int):
+    """OpenSSL EC key derivation is ~2 ms; cache it — signers reuse
+    their key for every vote (mirrors the reference's cached key objects)."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return ec.derive_private_key(d, ec.SECP256K1())
+
+
 def pubkey_from_secret(d: int) -> bytes:
-    x, y = pt_mul(d, (GX, GY))
-    return compress(x, y)
+    """Compressed pubkey via OpenSSL (the pure-Python pt_mul took ~20 ms
+    per key — 10k-validator fixtures need C-speed derivation)."""
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    return _derived_key(d).public_key().public_bytes(
+        Encoding.X962, PublicFormat.CompressedPoint
+    )
 
 
 def address(pub: bytes) -> bytes:
@@ -101,7 +121,7 @@ def sign(d: int, msg: bytes) -> bytes:
         decode_dss_signature,
     )
 
-    sk = ec.derive_private_key(d, ec.SECP256K1())
+    sk = _derived_key(d)
     r, s = decode_dss_signature(sk.sign(msg, ec.ECDSA(hashes.SHA256())))
     if s > HALF_N:
         s = N - s
@@ -110,7 +130,41 @@ def sign(d: int, msg: bytes) -> bytes:
 
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """ECDSA verify with the reference's malleability rule: s > N/2 is
-    rejected outright (secp256k1.go:204-208)."""
+    rejected outright (secp256k1.go:204-208). OpenSSL-backed (C speed);
+    verify_py below is the pure-Python oracle for kernel differential
+    tests."""
+    if len(sig) != 64:
+        return False
+    # only 33-byte compressed keys, like the reference (secp256k1.go:33
+    # PubKeySize) — OpenSSL would happily take 65-byte uncompressed
+    # points, a cross-implementation consensus divergence
+    if len(pub) != 33 or pub[0] not in (2, 3):
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s <= HALF_N):
+        return False
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
+
+    try:
+        pk = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), pub
+        )
+        pk.verify(
+            encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+        )
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def verify_py(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Pure-Python ECDSA verify (differential-test oracle)."""
     if len(sig) != 64:
         return False
     r = int.from_bytes(sig[:32], "big")

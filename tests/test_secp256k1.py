@@ -214,3 +214,33 @@ def test_mixed_key_commit_verification():
             validation.device_batch_fn(use_pallas=False),
         )
     assert ei.value.idx == secp_idx
+
+
+def test_ecdsa_pallas_matches_oracle():
+    """Pallas ECDSA kernel vs the pure-Python oracle (interpret mode on
+    CPU; Mosaic on TPU) — one tile incl. malformed/corrupt rows."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import secp256k1_ref as sref
+    from cometbft_tpu.crypto.keys import Secp256k1PrivKey
+    from cometbft_tpu.ops import ecdsa_pallas as cp
+
+    ks = [Secp256k1PrivKey.generate(bytes([i + 1]) * 32) for i in range(8)]
+    n = 24
+    msgs = [b"pallas-ecdsa-%d" % i for i in range(n)]
+    pubs = [ks[i % 8].pub_key().data for i in range(n)]
+    sigs = [ks[i % 8].sign(m) for i, m in enumerate(msgs)]
+    sigs[2] = sigs[2][:9] + bytes([sigs[2][9] ^ 1]) + sigs[2][10:]
+    sigs[5] = b"\x00" * 64                        # r = 0
+    pubs[7] = b"\x07" + pubs[7][1:]               # bad prefix
+    # high-S malleated twin of row 8 must be rejected (low-S rule)
+    r8 = sigs[8][:32]
+    s8 = int.from_bytes(sigs[8][32:], "big")
+    sigs[8] = r8 + (sref.N - s8).to_bytes(32, "big")
+    got = cp.verify_batch(pubs, msgs, sigs)
+    exp = np.asarray(
+        [sref.verify_py(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    )
+    np.testing.assert_array_equal(got, exp)
+    assert not exp[2] and not exp[5] and not exp[7] and not exp[8]
+    assert exp[0]
