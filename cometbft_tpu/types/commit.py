@@ -96,16 +96,29 @@ class Commit:
         )
 
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
-        """The bytes validator val_idx signed (block.go:880-883)."""
+        """The bytes validator val_idx signed (block.go:880-883).
+
+        Uses per-commit template encoders (only the timestamp and the
+        nil-vote flag vary across a commit's signatures) — this loop runs
+        once per signature in every verification path."""
         cs = self.signatures[val_idx]
-        return canonical.canonical_vote_bytes(
-            chain_id,
-            canonical.PRECOMMIT_TYPE,
-            self.height,
-            self.round,
-            cs.block_id(self.block_id),
-            cs.timestamp,
-        )
+        enc = getattr(self, "_sb_enc", None)
+        if enc is None or enc[0] != chain_id:
+            enc = (
+                chain_id,
+                canonical.CanonicalVoteEncoder(
+                    chain_id, canonical.PRECOMMIT_TYPE, self.height,
+                    self.round, self.block_id,
+                ),
+                canonical.CanonicalVoteEncoder(
+                    chain_id, canonical.PRECOMMIT_TYPE, self.height,
+                    self.round, None,
+                ),
+            )
+            self._sb_enc = enc
+        bid = cs.block_id(self.block_id)
+        use_nil = bid is None or bid.is_nil()
+        return enc[2 if use_nil else 1].bytes_for(cs.timestamp)
 
     def validate_basic(self) -> None:
         """block.go:893-917."""
