@@ -103,6 +103,14 @@ class BlockPool:
     def _max_peer_height(self) -> int:
         return max((p.height for p in self._peers.values()), default=0)
 
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return self._max_peer_height()
+
+    def num_peers(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
     def _pick_peer(self, height: int) -> Optional[_Peer]:
         best = None
         for p in self._peers.values():

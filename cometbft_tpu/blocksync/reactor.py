@@ -49,6 +49,11 @@ class BlocksyncReactor(BaseService):
         self.on_caught_up = on_caught_up
         self.poll_interval = poll_interval
         self.banned_peers: List[str] = []
+        self.on_ban = None  # p2p hook: disconnect a banned peer
+        # If no peer is ahead of us after this many seconds, declare
+        # caught-up (reactor.go:391's switch-to-consensus timer): a fresh
+        # network where everyone is at genesis must not wait forever.
+        self.grace = 3.0
         self._thread: Optional[threading.Thread] = None
 
     # -- service -----------------------------------------------------------
@@ -76,9 +81,25 @@ class BlocksyncReactor(BaseService):
 
     def _pool_routine(self) -> None:
         """poolRoutine (reactor.go:286)."""
+        started = time.time()
         while self.is_running():
             self.pool.make_requests()
-            if self.pool.is_caught_up():
+            elapsed = time.time() - started
+            if self.pool.num_peers() > 0:
+                # peers known: caught up when nobody is ahead (after a
+                # short grace so statuses can land)
+                done = self.pool.is_caught_up() or (
+                    elapsed > self.grace
+                    and self.pool.max_peer_height()
+                    <= self.state.last_block_height
+                )
+            else:
+                # zero peers: wait longer before giving up — declaring
+                # caught-up on an empty pool mid-handshake would strand
+                # a lagging node in consensus (the lonely-node arm keeps
+                # single-validator operation bootable)
+                done = elapsed > max(self.grace, 10.0)
+            if done:
                 if self.on_caught_up:
                     self.on_caught_up(self.state)
                 return
@@ -87,7 +108,13 @@ class BlocksyncReactor(BaseService):
             if len(run) < 2:
                 time.sleep(self.poll_interval)
                 continue
-            self._process_run(run)
+            try:
+                self._process_run(run)
+            except Exception:  # noqa: BLE001 - local store/app failure
+                import traceback
+
+                traceback.print_exc()
+                time.sleep(max(self.poll_interval, 0.25))  # retry, no ban
 
     def _process_run(self, run: List[Block]) -> None:
         """Verify blocks run[0..n-2] using each successor's LastCommit in
@@ -126,13 +153,18 @@ class BlocksyncReactor(BaseService):
                 return  # stop the run; loop re-requests and retries
             try:
                 self.block_exec.validate_block(self.state, first)
-                self.block_store.save_block(first, second.last_commit)
-                self.state = self.block_exec.apply_block(
-                    self.state, first.block_id(), first
-                )
             except Exception:
+                # validation failure = the peers fed us a bad block
                 self._punish_pair(first.header.height)
                 return
+            # persistence/apply failures are LOCAL (disk errors, app
+            # bugs): punishing the serving peers here would strip an
+            # honest node of its sync peers (round-2 advisory). Let the
+            # error surface; the run retries without banning.
+            self.block_store.save_block(first, second.last_commit)
+            self.state = self.block_exec.apply_block(
+                self.state, first.block_id(), first
+            )
             self.pool.pop_block()
 
     def _punish_pair(self, height: int) -> None:
@@ -146,6 +178,8 @@ class BlocksyncReactor(BaseService):
         for peer in peers - {None}:
             self.pool.ban_peer(peer)
             self.banned_peers.append(peer)
+            if self.on_ban is not None:
+                self.on_ban(peer)
 
     # -- introspection -----------------------------------------------------
 

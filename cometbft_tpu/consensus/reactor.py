@@ -1,15 +1,26 @@
 """Consensus reactor: gossips proposals and votes over the p2p switch.
 
-Reference: consensus/reactor.go — channels State/Data/Vote/VoteSetBits
-0x20-0x23 (:28-31), Receive demux (:241), per-peer gossip routines
-(:569,:737). This build floods proposals and votes on two channels
-(correct, if chattier than the reference's PeerState-bitarray-driven
-gossip; the dedup below keeps re-floods bounded) and relays on first
-sight so votes propagate beyond direct neighbors.
+Reference: consensus/reactor.go — channels State/Data/Vote 0x20-0x22
+(:28-31), Receive demux (:241), per-peer gossip routines (:569,:737),
+NewRoundStep announcements (:404 broadcastNewRoundStepMessage) and
+PeerState height/round/step tracking (peer_state.go).
+
+Design vs the reference: votes/proposals still flood (with dedup), but
+only AFTER synchronous signature verification against the current
+validator set — an invalid message punishes the sending peer and is
+never relayed (round-2 advisory: pre-verification relay let forged
+payloads flood-amplify network-wide). Catch-up is served from a
+per-peer monitor: every NewRoundStep a peer sends updates its
+PeerState; a peer whose height lags ours gets the decided block +
+seen commit for its height pushed on the DATA channel (the
+gossipDataRoutine catch-up arm, reactor.go:569), so a partitioned
+node that rejoins mid-height can finalize without full blocksync.
 """
 from __future__ import annotations
 
 import json
+import threading
+import time
 from typing import List
 
 from cometbft_tpu.consensus.state import ConsensusState, ProposalMsg
@@ -18,25 +29,64 @@ from cometbft_tpu.p2p.switch import Peer, Reactor
 from cometbft_tpu.types import serde
 from cometbft_tpu.types.proposal import Proposal
 
-DATA_CHANNEL = 0x21   # proposals + blocks (reactor.go DataChannel)
+STATE_CHANNEL = 0x20  # NewRoundStep (reactor.go StateChannel)
+DATA_CHANNEL = 0x21   # proposals + blocks + catch-up commits
 VOTE_CHANNEL = 0x22   # votes (reactor.go VoteChannel)
 
 
+class PeerState:
+    """Last-known consensus position of one peer (peer_state.go)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step = 0
+        self.last_update = 0.0
+        self.last_pushed_height = 0   # catch-up dedup
+        self.last_push_time = 0.0
+
+
 class ConsensusReactor(Reactor):
-    def __init__(self, cs: ConsensusState):
+    def __init__(self, cs: ConsensusState, catchup_interval: float = 0.25):
         super().__init__("CONSENSUS")
         self.cs = cs
         cs.broadcast = self._broadcast_own
+        cs.on_step_change = self._announce_step
         self._seen_votes = set()
         self._seen_proposals = set()
+        self._peer_states = {}  # peer -> PeerState
+        self._lock = threading.Lock()
+        self._catchup_interval = catchup_interval
+        self._catchup_thread = None
+        self._stop = threading.Event()
 
     def channel_descriptors(self) -> List[ChannelDescriptor]:
         return [
+            ChannelDescriptor(STATE_CHANNEL, priority=6,
+                              send_queue_capacity=100),
             ChannelDescriptor(DATA_CHANNEL, priority=10,
                               send_queue_capacity=100),
             ChannelDescriptor(VOTE_CHANNEL, priority=7,
                               send_queue_capacity=2000),
         ]
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        with self._lock:
+            self._peer_states[peer] = PeerState()
+        # tell the newcomer where we are (broadcastNewRoundStep on join)
+        peer.send(STATE_CHANNEL, self._step_bytes())
+        if self._catchup_thread is None:
+            self._catchup_thread = threading.Thread(
+                target=self._catchup_routine, daemon=True,
+                name="cs-catchup",
+            )
+            self._catchup_thread.start()
+
+    def remove_peer(self, peer: Peer, reason: str) -> None:
+        with self._lock:
+            self._peer_states.pop(peer, None)
 
     # -- outbound ----------------------------------------------------------
 
@@ -49,35 +99,176 @@ class ConsensusReactor(Reactor):
         elif kind == "proposal":
             self.switch.broadcast(DATA_CHANNEL, _proposal_bytes(payload))
 
+    def _step_bytes(self) -> bytes:
+        cs = self.cs
+        return json.dumps({
+            "t": "step", "h": cs.height, "r": cs.round, "s": cs.step,
+        }).encode()
+
+    def _announce_step(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(STATE_CHANNEL, self._step_bytes())
+
+    # -- catch-up (gossipDataRoutine's lagging-peer arm) -------------------
+
+    def _catchup_routine(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self._catchup_interval)
+            if self.switch is None:
+                continue
+            with self._lock:
+                peers = list(self._peer_states.items())
+            our_h = self.cs.height
+            now = time.time()
+            for peer, ps in peers:
+                if not 0 < ps.height < our_h:
+                    continue
+                # push each height once; re-push only after a timeout in
+                # case the first one was lost (avoids re-serializing the
+                # same block 4x/second at a slow peer)
+                if ps.last_pushed_height == ps.height and \
+                        now - ps.last_push_time < 2.0:
+                    continue
+                ps.last_pushed_height = ps.height
+                ps.last_push_time = now
+                self._send_catchup(peer, ps.height)
+
+    def _send_catchup(self, peer: Peer, height: int) -> None:
+        """Push the decided block + its seen commit for the peer's height
+        so it can finalize and advance (reactor.go:569 catch-up arm)."""
+        try:
+            block = self.cs.block_store.load_block(height)
+            commit = self.cs.block_store.load_seen_commit(height)
+        except Exception:  # noqa: BLE001 - store closing during shutdown
+            return
+        if block is None or commit is None:
+            return
+        # block rides as its serialized string: one encode here, one
+        # decode on receive (not four)
+        peer.send(DATA_CHANNEL, json.dumps({
+            "t": "commit_block",
+            "b": serde.block_to_json(block),
+            "c": serde.commit_to_j(commit),
+        }).encode())
+
+    def stop_routines(self) -> None:
+        self._stop.set()
+
     # -- inbound -----------------------------------------------------------
 
     def receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
         try:
-            if chan_id == VOTE_CHANNEL:
-                vote = serde.vote_from_j(json.loads(msg.decode()))
-                key = (vote.height, vote.round, vote.vote_type,
-                       vote.validator_address, vote.signature)
-                if key in self._seen_votes:
-                    return
-                self._seen_votes.add(key)
-                if len(self._seen_votes) > 50000:
-                    self._seen_votes.clear()
-                self.cs.receive_vote(vote)
-                # relay so votes reach non-neighbors (flood w/ dedup)
-                self.switch.broadcast(VOTE_CHANNEL, msg)
+            if chan_id == STATE_CHANNEL:
+                self._receive_step(peer, msg)
+            elif chan_id == VOTE_CHANNEL:
+                self._receive_vote(peer, msg)
             elif chan_id == DATA_CHANNEL:
-                pm = _proposal_from_bytes(msg)
-                key = (pm.proposal.height, pm.proposal.round,
-                       pm.proposal.signature)
-                if key in self._seen_proposals:
-                    return
-                self._seen_proposals.add(key)
-                if len(self._seen_proposals) > 1000:
-                    self._seen_proposals.clear()
-                self.cs.receive_proposal(pm)
-                self.switch.broadcast(DATA_CHANNEL, msg)
-        except Exception as e:  # noqa: BLE001 - bad peer message
+                self._receive_data(peer, msg)
+        except _PeerMisbehavior as e:
+            self.switch.stop_peer_for_error(peer, str(e))
+        except Exception as e:  # noqa: BLE001 - undecodable peer message
             self.switch.stop_peer_for_error(peer, f"bad consensus msg: {e}")
+
+    def _receive_step(self, peer: Peer, msg: bytes) -> None:
+        j = json.loads(msg.decode())
+        if j.get("t") != "step":
+            raise ValueError("bad state-channel message")
+        with self._lock:
+            ps = self._peer_states.setdefault(peer, PeerState())
+            ps.height = int(j["h"])
+            ps.round = int(j["r"])
+            ps.step = int(j["s"])
+            ps.last_update = time.time()
+
+    def _receive_vote(self, peer: Peer, msg: bytes) -> None:
+        vote = serde.vote_from_j(json.loads(msg.decode()))
+        key = (vote.height, vote.round, vote.vote_type,
+               vote.validator_address, vote.signature)
+        if key in self._seen_votes:
+            return
+        cs = self.cs
+        if vote.height != cs.height:
+            # stale or future vote: neither verifiable against the current
+            # set nor useful to the state machine; catch-up channels (the
+            # commit push above / blocksync) cover lagging nodes. Not a
+            # punishable offence — honest peers race height transitions.
+            return
+        # synchronous verification BEFORE relay or enqueue: a forged vote
+        # must cost the sender its connection and go no further (round-2
+        # advisory on pre-validation flood amplification)
+        val = cs.state.validators.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            # benign race: the consensus thread may have advanced the
+            # height (and swapped validator sets) between our height
+            # check and this lookup — only punish when the heights still
+            # agree, i.e. the peer really sent a bogus index
+            if vote.height != cs.height:
+                return
+            raise _PeerMisbehavior("vote with bogus validator index")
+        try:
+            vote.verify(cs.state.chain_id, val.pub_key)  # raises on forgery
+        except Exception as e:
+            raise _PeerMisbehavior(f"invalid vote signature: {e}") from e
+        self._seen_votes.add(key)
+        if len(self._seen_votes) > 50000:
+            self._seen_votes.clear()
+        cs.receive_vote(vote)
+        # relay so votes reach non-neighbors (flood w/ dedup)
+        self.switch.broadcast(VOTE_CHANNEL, msg)
+
+    def _receive_data(self, peer: Peer, msg: bytes) -> None:
+        j = json.loads(msg.decode())
+        if j.get("t") == "commit_block":
+            # catch-up push: a decided block + its +2/3 seen commit.
+            # Reactor-side gate BEFORE the expensive consensus-thread
+            # verification: structural consistency (punishable) and a
+            # per-peer rate limit so a forged-commit loop can't starve
+            # the consensus queue with full VerifyCommitLight runs.
+            block = serde.block_from_json(j["b"])
+            commit = serde.commit_from_j(j["c"])
+            if commit is None or block is None or \
+                    block.hash() != commit.block_id.hash or \
+                    block.header.height != commit.height:
+                raise _PeerMisbehavior("inconsistent commit_block push")
+            if commit.height != self.cs.height:
+                return  # stale push (height raced forward): ignore
+            with self._lock:
+                ps = self._peer_states.setdefault(peer, PeerState())
+                now = time.time()
+                if now - getattr(ps, "last_commit_block", 0.0) < 0.5:
+                    return  # rate limit: at most 2 pushes/sec/peer
+                ps.last_commit_block = now
+            self.cs.receive_commit_block(block, commit)
+            return
+        pm = _proposal_from_bytes(msg)
+        key = (pm.proposal.height, pm.proposal.round,
+               pm.proposal.signature)
+        if key in self._seen_proposals:
+            return
+        cs = self.cs
+        p = pm.proposal
+        if p.height != cs.height:
+            return
+        # verify the proposer's signature for the proposal's own round
+        # before relaying (late rounds are still relayable — peers may be
+        # ahead of us)
+        proposer = cs.proposer_for_round(p.round)
+        if proposer is None:
+            return
+        p.validate_basic()
+        if not p.verify(cs.state.chain_id, proposer.pub_key):
+            raise _PeerMisbehavior("invalid proposal signature")
+        if pm.block.hash() != p.block_id.hash:
+            raise _PeerMisbehavior("proposal block/hash mismatch")
+        self._seen_proposals.add(key)
+        if len(self._seen_proposals) > 1000:
+            self._seen_proposals.clear()
+        cs.receive_proposal(pm)
+        self.switch.broadcast(DATA_CHANNEL, msg)
+
+
+class _PeerMisbehavior(Exception):
+    pass
 
 
 def _vote_bytes(vote) -> bytes:

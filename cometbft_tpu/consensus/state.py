@@ -123,6 +123,14 @@ class ConsensusState(BaseService):
         # test override hooks (state.go:122-125 decideProposal/doPrevote)
         self.decide_proposal_fn = self._default_decide_proposal
         self.do_prevote_fn = self._default_do_prevote
+        # reactor hook: fired on height/round/step changes so peers learn
+        # our position (reactor.go:404 broadcastNewRoundStepMessage)
+        self.on_step_change: Optional[Callable] = None
+        # evidence wiring (node/node.go:369 evidence pool into consensus):
+        # conflicting votes become DuplicateVoteEvidence; on_evidence lets
+        # the evidence reactor gossip what we found locally
+        self.evidence_pool = None
+        self.on_evidence: Optional[Callable] = None
 
     # ---------------------------------------------------------------------
     # service lifecycle
@@ -148,6 +156,19 @@ class ConsensusState(BaseService):
     def _schedule_round0(self) -> None:
         self.internal_queue.put(("start_round", self.height, 0))
 
+    def reset_to_state(self, state: State) -> None:
+        """Adopt a state produced by a sync path (blocksync/statesync)
+        BEFORE starting — the SwitchToConsensus seam (reactor.go:115)."""
+        assert not self.is_running(), "reset only before start"
+        self.state = state
+        self.height = state.last_block_height + 1
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.votes = HeightVoteSet(state.chain_id, self.height,
+                                   state.validators)
+        self.round_validators = state.validators
+        self.commit_round = -1
+
     # ---------------------------------------------------------------------
     # message intake
     # ---------------------------------------------------------------------
@@ -157,6 +178,26 @@ class ConsensusState(BaseService):
 
     def receive_vote(self, vote: Vote) -> None:
         self.msg_queue.put(("vote", VoteMsg(vote)))
+
+    def receive_commit_block(self, block, commit) -> None:
+        """Catch-up intake: a decided block + its +2/3 commit, pushed by a
+        peer that saw us lagging (reactor.go gossipDataRoutine catch-up)."""
+        self.msg_queue.put(("commit_block", block, commit))
+
+    def _notify_step(self) -> None:
+        if self.on_step_change is not None:
+            try:
+                self.on_step_change()
+            except Exception:  # noqa: BLE001 - reactor must not kill us
+                _log.exception("on_step_change hook failed")
+
+    def proposer_for_round(self, round_: int):
+        """The proposer a given round of the current height would elect
+        (reactor-side proposal verification for rounds != self.round)."""
+        vs = self.state.validators
+        if round_ <= 0:
+            return vs.get_proposer()
+        return vs.copy_increment_proposer_priority(round_).get_proposer()
 
     def _on_timeout(self, ti: TimeoutInfo) -> None:
         self.internal_queue.put(("timeout", ti))
@@ -201,6 +242,8 @@ class ConsensusState(BaseService):
             self._try_add_vote(item[1].vote)
         elif kind == "timeout":
             self._handle_timeout(item[1])
+        elif kind == "commit_block":
+            self._apply_commit_block(item[1], item[2])
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """state.go:934 handleTimeout."""
@@ -312,9 +355,10 @@ class ConsensusState(BaseService):
         if round_ == 0:
             self.round_validators = self.state.validators
         else:
-            rv = self.state.validators.copy()
-            rv.increment_proposer_priority(round_)
-            self.round_validators = rv
+            self.round_validators = \
+                self.state.validators.copy_increment_proposer_priority(
+                    round_
+                )
         self.round = round_
         self.step = STEP_NEW_ROUND
         self._triggered_precommit_wait = False
@@ -322,6 +366,7 @@ class ConsensusState(BaseService):
             self.proposal = None
             self.proposal_block = None
         self.votes.set_round(round_)
+        self._notify_step()
         self._enter_propose(height, round_)
 
     def _proposer(self):
@@ -384,6 +429,30 @@ class ConsensusState(BaseService):
         WAL logs proposals before validation, so a replay that skipped
         verification would turn a live-rejected forgery into the accepted
         proposal after restart."""
+        # Block recovery at commit step (round-2 advisory): once a +2/3
+        # precommit majority decided a block we don't hold, ANY proposal
+        # carrying that block must be accepted regardless of its round —
+        # the block content is authenticated by its hash matching the
+        # majority, not by the proposal signature (the reference re-seeds
+        # ProposalBlockParts from the commit BlockID in enterCommit).
+        if self.commit_round >= 0 and self.proposal_block is None:
+            maj = self.votes.precommits(
+                self.commit_round
+            ).two_thirds_majority()
+            if (maj is not None and not maj.is_nil()
+                    and msg.block.hash() == maj.hash):
+                # the header hash matching +2/3 precommits authenticates
+                # the HEADER; the body must still validate against it
+                # (data_hash etc.) or an attacker could pair the real
+                # header with tampered txs
+                try:
+                    self.block_exec.validate_block(self.state, msg.block)
+                except Exception as e:  # noqa: BLE001
+                    _log.warning("commit-recovery block rejected: %s", e)
+                    return
+                self.proposal_block = msg.block
+                self._try_finalize_commit(self.height)
+                return
         if self.proposal is not None:
             return
         p = msg.proposal
@@ -411,6 +480,7 @@ class ConsensusState(BaseService):
         if self.step >= STEP_PREVOTE:
             return
         self.step = STEP_PREVOTE
+        self._notify_step()
         self.do_prevote_fn(height, round_)
         self._check_vote_quorums()
 
@@ -458,6 +528,7 @@ class ConsensusState(BaseService):
         if round_ != self.round or self.step >= STEP_PRECOMMIT:
             return
         self.step = STEP_PRECOMMIT
+        self._notify_step()
         maj = self.votes.prevotes(round_).two_thirds_majority()
         if maj is None:
             self._sign_add_vote(canonical.PRECOMMIT_TYPE, BlockID())
@@ -533,8 +604,8 @@ class ConsensusState(BaseService):
             return
         try:
             added = self.votes.add_vote(vote, verify=True)
-        except ConflictingVoteError:
-            # evidence collection lands with the evidence pool
+        except ConflictingVoteError as e:
+            self._submit_equivocation(e)
             return
         except VoteSetError as e:
             # invalid vote (bad sig, unknown validator): logged-and-dropped
@@ -546,6 +617,28 @@ class ConsensusState(BaseService):
             return
         if added:
             self._check_vote_quorums(vote.round)
+
+    def _submit_equivocation(self, e: ConflictingVoteError) -> None:
+        """Conflicting votes -> DuplicateVoteEvidence -> pool (+ gossip).
+        Reference: consensus/state.go:2161 addVote's evidence arm."""
+        if self.evidence_pool is None:
+            return
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+        _, val = self.state.validators.get_by_address(
+            e.new.validator_address
+        )
+        if val is None:
+            return
+        ev = DuplicateVoteEvidence.from_votes(
+            e.existing, e.new, self.state.last_block_time,
+            self.state.validators.total_voting_power(), val.voting_power,
+        )
+        try:
+            if self.evidence_pool.add_evidence(ev) and self.on_evidence:
+                self.on_evidence(ev)
+        except Exception as ex:  # noqa: BLE001 - evidence must not stall us
+            _log.warning("equivocation evidence rejected: %s", ex)
 
     def _check_vote_quorums(self, vr: Optional[int] = None) -> None:
         """Quorum-driven step transitions (state.go addVote tail), keyed on
@@ -592,6 +685,7 @@ class ConsensusState(BaseService):
             return
         self.step = STEP_COMMIT
         self.commit_round = round_
+        self._notify_step()
         self._try_finalize_commit(height)
 
     def _try_finalize_commit(self, height: int) -> None:
@@ -618,6 +712,53 @@ class ConsensusState(BaseService):
         self.state = new_state
         self._advance_to_height(new_state)
 
+    def _apply_commit_block(self, block: Block, commit: Commit) -> None:
+        """Fast-forward from a peer's catch-up push: verify the +2/3
+        commit over our validator set, then persist + apply. Not WAL-
+        logged as a consensus message — a crash mid-apply restarts at the
+        old height and the catch-up push simply recurs.
+
+        Reference analog: blocksync's verify-then-apply step
+        (blocksync/reactor.go:463-513) applied to a single pushed block
+        inside consensus."""
+        from cometbft_tpu.types import validation as tv
+
+        if commit is None or block is None:
+            return
+        if commit.height != self.height:
+            return
+        if block.hash() != commit.block_id.hash:
+            _log.warning("catch-up block/commit hash mismatch at h=%d",
+                         commit.height)
+            return
+        try:
+            tv.verify_commit_light(
+                self.state.chain_id, self.state.validators,
+                commit.block_id, commit.height, commit,
+                batch_fn=getattr(self.block_exec, "batch_fn", None),
+            )
+        except tv.VerificationError as e:
+            _log.warning("catch-up commit rejected at h=%d: %s",
+                         commit.height, e)
+            return
+        # full block validation BEFORE anything is persisted: the commit
+        # authenticates only the header; a tampered body must not reach
+        # the store or the app (code-review finding, round 3)
+        try:
+            self.block_exec.validate_block(self.state, block)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("catch-up block invalid at h=%d: %s",
+                         commit.height, e)
+            return
+        self.block_store.save_block(block, commit)
+        if self.wal:
+            self.wal.write_end_height(commit.height)
+        new_state = self.block_exec.apply_block(
+            self.state, commit.block_id, block, validate=False
+        )
+        self.state = new_state
+        self._advance_to_height(new_state)
+
     def _advance_to_height(self, new_state: State) -> None:
         """updateToState (state.go:2005) + scheduleRound0."""
         self.height = new_state.last_block_height + 1
@@ -638,6 +779,7 @@ class ConsensusState(BaseService):
         self.ticker.schedule(TimeoutInfo(
             self.height, 0, STEP_NEW_HEIGHT, self.timeouts.commit,
         ))
+        self._notify_step()
 
     # ---------------------------------------------------------------------
     # test / observer helpers

@@ -35,7 +35,13 @@ class Node(BaseService):
         batch_fn: Optional[Callable] = None,
         p2p: bool = False,
         node_key=None,
+        blocksync: bool = False,
+        statesync_light_client=None,
+        statesync_discovery: float = 45.0,
     ):
+        """statesync_light_client: a light.Client already trusting a root
+        header; providing it turns on the statesync->blocksync->consensus
+        start sequence (node/node.go:527, statesync/syncer.go:145)."""
         super().__init__("Node")
         self.app = app
         self.home = home
@@ -79,8 +85,21 @@ class Node(BaseService):
                 self.app.commit()
 
         self.mempool = Mempool(app)
+        # evidence pool backed by the state store's validator history
+        # (node/node.go:369 createEvidenceReactor)
+        from cometbft_tpu.evidence.pool import EvidencePool
+
+        self.evidence_pool = EvidencePool(
+            state.chain_id, self.state_store.load_validators
+        )
+        self.evidence_pool.height = state.last_block_height
+        self.evidence_pool.time_s = state.last_block_time.seconds
+        from cometbft_tpu.types.event_bus import EventBus
+
+        self.event_bus = EventBus()
         self.block_exec = BlockExecutor(
-            app, self.state_store, batch_fn=batch_fn, mempool=self.mempool
+            app, self.state_store, batch_fn=batch_fn, mempool=self.mempool,
+            evidence_pool=self.evidence_pool, event_bus=self.event_bus,
         )
         self.consensus = ConsensusState(
             state,
@@ -91,12 +110,22 @@ class Node(BaseService):
             broadcast=broadcast,
             timeouts=timeouts,
         )
+        self.consensus.evidence_pool = self.evidence_pool
 
         # optional real p2p stack (node/node.go:443-447 createTransport/
         # createSwitch); when absent, `broadcast` (in-memory hub) rules
         self.switch = None
         self.mempool_reactor = None
+        self.consensus_reactor = None
+        self.blocksync_engine = None
+        self.blocksync_reactor = None
+        self._blocksync_first = blocksync
+        self._statesync_discovery = statesync_discovery
         if p2p:
+            from cometbft_tpu.blocksync.p2p_reactor import (
+                BlocksyncP2PReactor,
+            )
+            from cometbft_tpu.blocksync.reactor import BlocksyncReactor
             from cometbft_tpu.consensus.reactor import ConsensusReactor
             from cometbft_tpu.mempool.reactor import MempoolReactor
             from cometbft_tpu.p2p.key import NodeKey
@@ -106,13 +135,63 @@ class Node(BaseService):
                 os.path.join(home, "node_key.json") if home else None
             )
             self.switch = Switch(nk, state.chain_id)
-            self.switch.add_reactor(ConsensusReactor(self.consensus))
+            self.consensus_reactor = ConsensusReactor(self.consensus)
+            self.switch.add_reactor(self.consensus_reactor)
             self.mempool_reactor = MempoolReactor(self.mempool)
             self.switch.add_reactor(self.mempool_reactor)
+            if blocksync:
+                # syncing node: blocksync drives first, consensus starts
+                # at SwitchToConsensus (node.go:527 sequencing)
+                self.blocksync_engine = BlocksyncReactor(
+                    state, self.block_exec, self.block_store,
+                    on_caught_up=self._switch_to_consensus,
+                )
+            # every p2p node SERVES blocks even when not syncing itself
+            self.blocksync_reactor = BlocksyncP2PReactor(
+                self.blocksync_engine, self.block_store
+            )
+            self.switch.add_reactor(self.blocksync_reactor)
+            from cometbft_tpu.evidence.reactor import EvidenceReactor
+
+            self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+            self.switch.add_reactor(self.evidence_reactor)
+            self.consensus.on_evidence = \
+                self.evidence_reactor.broadcast_evidence
+
+            # statesync (serve snapshots always; sync when a trusted
+            # light client was provided and we are at genesis)
+            from cometbft_tpu.statesync.p2p_reactor import (
+                StatesyncP2PReactor,
+            )
+
+            self.statesync_syncer = None
+            if statesync_light_client is not None and \
+                    state.last_block_height == 0:
+                from cometbft_tpu.statesync.syncer import (
+                    LightStateProvider,
+                    Syncer,
+                )
+
+                self.statesync_syncer = Syncer(
+                    app, LightStateProvider(statesync_light_client)
+                )
+            self.statesync_reactor = StatesyncP2PReactor(
+                app, self.statesync_syncer
+            )
+            self.switch.add_reactor(self.statesync_reactor)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0):
         """Start the p2p listener; returns our NetAddress."""
         return self.switch.listen(host, port)
+
+    def rpc_listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Start the JSON-RPC server (node/node.go:527 RPC listeners);
+        returns the base URL."""
+        from cometbft_tpu.rpc.server import RPCServer
+
+        self.rpc_server = RPCServer(self, host, port)
+        self.rpc_server.start()
+        return self.rpc_server.address
 
     def dial(self, addr, persistent: bool = True) -> None:
         self.switch.dial_peer(addr, persistent=persistent)
@@ -120,10 +199,74 @@ class Node(BaseService):
     def on_start(self) -> None:
         if self.switch is not None:
             self.switch.start()
+        if getattr(self, "statesync_syncer", None) is not None:
+            import threading
+
+            threading.Thread(target=self._run_statesync, daemon=True,
+                             name="statesync").start()
+        elif self.blocksync_engine is not None:
+            self.blocksync_engine.start()
+        else:
+            self.consensus.start()
+
+    def _run_statesync(self) -> None:
+        """statesync -> blocksync -> consensus (node/node.go:527)."""
+        try:
+            synced = self.statesync_syncer.sync_any(
+                discovery_time=self._statesync_discovery
+            )
+            if not self.is_running():
+                return  # node stopped mid-sync: stores are closed
+            # adopt: persist state + the restore height's commit, then
+            # let blocksync close the remaining gap. Inside the try:
+            # provider/light-client errors here must also fall back, not
+            # silently kill this daemon thread.
+            commit = self.statesync_syncer.state_provider.commit_at(
+                synced.last_block_height
+            )
+            self.state_store.save(synced)
+            self.block_store.save_seen_commit(
+                synced.last_block_height, commit
+            )
+        except Exception:  # noqa: BLE001 - any sync failure -> fallback
+            import logging
+
+            if not self.is_running():
+                return  # shutdown race, not a sync failure
+            logging.getLogger(__name__).exception(
+                "statesync failed; falling back to blocksync from genesis"
+            )
+            if self.blocksync_engine is not None:
+                self.blocksync_engine.start()
+            else:
+                self.consensus.start()
+            return
+        if self.blocksync_engine is not None:
+            self.blocksync_engine.state = synced
+            self.blocksync_engine.pool.height = \
+                synced.last_block_height + 1
+            self.blocksync_engine.start()
+        else:
+            self._switch_to_consensus(synced)
+
+    def _switch_to_consensus(self, synced_state: State) -> None:
+        """Blocksync caught up: hand the synced state to consensus
+        (blocksync/reactor.go:391-401 SwitchToConsensus)."""
+        self.consensus.reset_to_state(synced_state)
         self.consensus.start()
 
     def on_stop(self) -> None:
-        self.consensus.stop()
+        if getattr(self, "rpc_server", None) is not None:
+            self.rpc_server.stop()
+        if self.consensus.is_running():
+            self.consensus.stop()
+        if self.blocksync_engine is not None and \
+                self.blocksync_engine.is_running():
+            self.blocksync_engine.stop()
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.stop_routines()
+        if self.blocksync_reactor is not None:
+            self.blocksync_reactor.stop_routines()
         if self.switch is not None:
             self.switch.stop()
         self.block_store.close()

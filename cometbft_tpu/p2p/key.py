@@ -33,7 +33,9 @@ class NodeKey:
         nk = NodeKey(PrivKey.generate(seed))
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(path, "w") as f:
+            # private key material: 0600, like the reference's key files
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
                 json.dump({"id": nk.node_id,
                            "priv_key": nk.priv_key.data.hex()}, f)
         return nk

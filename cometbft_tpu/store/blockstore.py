@@ -56,12 +56,26 @@ class BlockStore:
                 ),
             )
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """Store a commit with NO block (store.go:277 SaveSeenCommit):
+        statesync persists the restore height's commit so a freshly
+        synced proposer can build height+1's LastCommit."""
+        with self._lock, self._db:
+            # upsert ONLY the seen_commit column: a plain REPLACE would
+            # null out an existing block row at this height
+            self._db.execute(
+                "INSERT INTO blocks(height, seen_commit) VALUES (?,?) "
+                "ON CONFLICT(height) DO UPDATE SET "
+                "seen_commit=excluded.seen_commit",
+                (height, serde.json.dumps(serde.commit_to_j(commit))),
+            )
+
     def load_block(self, height: int) -> Optional[Block]:
         cur = self._db.execute(
             "SELECT block FROM blocks WHERE height=?", (height,)
         )
         row = cur.fetchone()
-        return serde.block_from_json(row[0]) if row else None
+        return serde.block_from_json(row[0]) if row and row[0] else None
 
     def load_block_by_hash(self, h: bytes) -> Optional[Block]:
         cur = self._db.execute(
@@ -77,7 +91,7 @@ class BlockStore:
             "SELECT commit_json FROM blocks WHERE height=?", (height + 1,)
         )
         row = cur.fetchone()
-        if row:
+        if row and row[0]:
             return serde.commit_from_j(serde.json.loads(row[0]))
         return self.load_seen_commit(height)
 
@@ -87,7 +101,8 @@ class BlockStore:
         )
         row = cur.fetchone()
         return (
-            serde.commit_from_j(serde.json.loads(row[0])) if row else None
+            serde.commit_from_j(serde.json.loads(row[0]))
+            if row and row[0] else None
         )
 
     def prune_blocks(self, retain_height: int) -> int:

@@ -176,3 +176,105 @@ def test_four_validators_over_tcp(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_fifth_node_joins_and_catches_up(tmp_path):
+    """A 5th (non-validator) node joins a running 4-node TCP net from
+    genesis: blocksync fetches the back-blocks over the BLOCKSYNC
+    channel, then consensus keeps it at the tip (round-2 verdict item 4;
+    blocksync/reactor.go:286 + :391 SwitchToConsensus)."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis("join-chain", vals)
+    nodes, addrs = [], []
+    for i, priv in enumerate(privs):
+        n = Node(KVStoreApplication(), state.copy(), privval=FilePV(priv),
+                 home=str(tmp_path / f"n{i}"), timeouts=FAST, p2p=True,
+                 node_key=NodeKey(PrivKey.generate(bytes([0x50 + i]) * 32)))
+        addrs.append(n.listen())
+        nodes.append(n)
+    for n in nodes:
+        n.start()
+    late = None
+    try:
+        for i, n in enumerate(nodes):
+            for j, a in enumerate(addrs):
+                if i != j:
+                    n.dial(a)
+        # let the validators build history first
+        assert nodes[0].consensus.wait_for_height(4, timeout=90)
+
+        late = Node(KVStoreApplication(), state.copy(),
+                    home=str(tmp_path / "late"), timeouts=FAST, p2p=True,
+                    blocksync=True,
+                    node_key=NodeKey(PrivKey.generate(b"\x77" * 32)))
+        late.listen()
+        late.start()
+        for a in addrs:
+            late.dial(a)
+        target = nodes[0].height() + 2
+        deadline = time.time() + 120
+        while time.time() < deadline and late.height() < target:
+            time.sleep(0.2)
+        assert late.height() >= target, \
+            f"late node stuck at {late.height()} (target {target})"
+        # it agrees on history with the validators
+        h2 = late.block_store.load_block(2).hash()
+        assert h2 == nodes[0].block_store.load_block(2).hash()
+        # and its consensus engine is live at the tip
+        assert late.consensus.is_running()
+    finally:
+        for n in nodes:
+            n.stop()
+        if late is not None:
+            late.stop()
+
+
+def test_partitioned_node_rejoins(tmp_path):
+    """A validator cut off from the net resumes after reconnection: the
+    consensus reactor's catch-up push (NewRoundStep-driven commit_block)
+    carries it back to the tip (round-2 verdict item 4)."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis("part-chain", vals)
+    nodes, addrs = [], []
+    for i, priv in enumerate(privs):
+        n = Node(KVStoreApplication(), state.copy(), privval=FilePV(priv),
+                 home=str(tmp_path / f"n{i}"), timeouts=FAST, p2p=True,
+                 node_key=NodeKey(PrivKey.generate(bytes([0x60 + i]) * 32)))
+        addrs.append(n.listen())
+        nodes.append(n)
+    for n in nodes:
+        n.start()
+    try:
+        for i, n in enumerate(nodes):
+            for j, a in enumerate(addrs):
+                if i != j:
+                    n.dial(a)
+        assert nodes[0].consensus.wait_for_height(2, timeout=90)
+        # partition node 3: drop all its peers (and everyone drops it)
+        victim = nodes[3]
+        for p in list(victim.switch.peers.values()):
+            victim.switch.stop_peer_for_error(p, "partition test")
+        victim.switch.persistent.clear()
+        for n in nodes[:3]:
+            for p in list(n.switch.peers.values()):
+                if p.peer_id == victim.switch.node_key.node_id:
+                    n.switch.stop_peer_for_error(p, "partition test")
+            n.switch.persistent.clear()
+        h_cut = victim.height()
+        # the 3 remaining validators (power 30/40 > 2/3) keep committing
+        assert nodes[0].consensus.wait_for_height(h_cut + 3, timeout=90)
+        assert victim.height() <= h_cut + 1  # victim is behind
+        # reconnect: catch-up pushes bring the victim to the tip
+        for a in addrs[:3]:
+            victim.dial(a)
+        target = nodes[0].height() + 1
+        deadline = time.time() + 120
+        while time.time() < deadline and victim.height() < target:
+            time.sleep(0.2)
+        assert victim.height() >= target, \
+            f"victim stuck at {victim.height()} (target {target})"
+    finally:
+        for n in nodes:
+            n.stop()
