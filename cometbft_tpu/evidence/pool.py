@@ -38,7 +38,7 @@ class EvidencePool:
         self.max_age_blocks = max_age_blocks
         self.max_age_seconds = max_age_seconds
         self._pending: Dict[bytes, DuplicateVoteEvidence] = {}
-        self._committed: set = set()
+        self._committed: dict = {}  # key -> commit height
         self._lock = threading.Lock()
         self.height = 0  # latest committed block height
         self.time_s = 0  # latest committed block time (seconds)
@@ -111,12 +111,22 @@ class EvidencePool:
             self.time_s = time_s
             for ev in evs:
                 key = ev.hash()
-                self._committed.add(key)
+                self._committed[key] = (height, time_s)
                 self._pending.pop(key, None)
             # prune expired pending
             for key in [k for k, e in self._pending.items()
                         if self._expired_locked(e)]:
                 del self._pending[key]
+            # prune committed markers once the evidence is expired by
+            # BOTH bounds (same rule as _expired_locked: age-based
+            # rejection only kicks in when block-age AND time-age are
+            # exceeded, so dropping a marker earlier would reopen a
+            # double-punishment window)
+            cutoff_h = height - self.max_age_blocks
+            cutoff_t = time_s - self.max_age_seconds
+            for key in [k for k, (h, t) in self._committed.items()
+                        if h < cutoff_h and t < cutoff_t]:
+                del self._committed[key]
 
     def size(self) -> int:
         with self._lock:

@@ -74,14 +74,36 @@ class Mempool:
                 total += len(tx)
         return out
 
-    def update(self, height: int, committed: List[bytes]) -> None:
-        """Remove committed txs (clist_mempool.go:577 Update)."""
+    def update(self, height: int, committed: List[bytes],
+               recheck: bool = True) -> None:
+        """Remove committed txs, then re-run CheckTx on the survivors
+        (clist_mempool.go:577 Update + :631/:646 recheckTxs): a tx whose
+        validity depended on state the block just changed must not be
+        re-proposed forever."""
         with self._lock:
             committed_set = set(committed)
-            self._txs = deque(
-                t for t in self._txs if t not in committed_set
-            )
+            survivors = [t for t in self._txs if t not in committed_set]
+            self._txs = deque(survivors)
             self._tx_set -= committed_set
+        if not recheck or not survivors:
+            return
+        keep = []
+        for tx in survivors:
+            resp = self.app.check_tx(
+                abci.RequestCheckTx(tx=tx, recheck=True)
+            )
+            if resp.code == abci.CODE_TYPE_OK:
+                keep.append(tx)
+        with self._lock:
+            dropped = set(survivors) - set(keep)
+            if dropped:
+                self._txs = deque(
+                    t for t in self._txs if t not in dropped
+                )
+                self._tx_set -= dropped
+                for t in dropped:
+                    # invalid txs leave the cache (resubmittable later)
+                    self._cache.pop(t, None)
 
     def flush(self) -> None:
         with self._lock:

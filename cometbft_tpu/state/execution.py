@@ -51,11 +51,13 @@ class BlockExecutor:
 
     def __init__(self, app: abci.Application, state_store,
                  batch_fn: Optional[Callable] = None,
-                 mempool=None):
+                 mempool=None, evidence_pool=None, event_bus=None):
         self.app = app
         self.state_store = state_store
         self.batch_fn = batch_fn
         self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
 
     # -- proposal ------------------------------------------------------------
 
@@ -76,7 +78,17 @@ class BlockExecutor:
                 proposer_address=proposer_address,
             )
         )
-        t = block_time or Timestamp.now()
+        if block_time is not None:
+            t = block_time
+        elif height == state.initial_height or last_commit is None \
+                or not last_commit.signatures:
+            t = state.last_block_time  # genesis time seeds the chain
+        else:
+            # BFT time (state/validation.go:123): block time is the
+            # voting-power-weighted median of LastCommit timestamps
+            from cometbft_tpu.types.bft_time import median_time
+
+            t = median_time(last_commit, state.last_validators)
         header = Header(
             chain_id=state.chain_id,
             height=height,
@@ -89,7 +101,11 @@ class BlockExecutor:
             last_results_hash=state.last_results_hash,
             proposer_address=proposer_address,
         )
-        block = Block(header, Data(list(rpp.txs)), last_commit)
+        evs = (self.evidence_pool.pending_evidence(
+                   state.consensus_params.evidence.max_bytes)
+               if self.evidence_pool else [])
+        block = Block(header, Data(list(rpp.txs)), last_commit,
+                      evidence=evs)
         block.fill_header()
         return block
 
@@ -129,6 +145,25 @@ class BlockExecutor:
             raise ExecutionError("wrong Header.LastResultsHash")
         if not state.validators.has_address(h.proposer_address):
             raise ExecutionError("proposer not in validator set")
+        # median-time rule (state/validation.go:123)
+        if h.height == state.initial_height:
+            if h.time != state.last_block_time:
+                raise ExecutionError(
+                    "block time for initial block must equal genesis time"
+                )
+        elif block.last_commit is not None and \
+                block.last_commit.signatures:
+            from cometbft_tpu.types.bft_time import median_time
+
+            want = median_time(block.last_commit, state.last_validators)
+            if h.time != want:
+                raise ExecutionError(
+                    f"invalid block time: got {h.time}, median is {want}"
+                )
+        if block.evidence and self.evidence_pool is not None:
+            # every piece must verify and be neither committed nor
+            # expired (evidence/pool.go:192 CheckEvidence)
+            self.evidence_pool.check_evidence(block.evidence)
         # full-power commit check against the set that signed it
         # (state/validation.go:92)
         if h.height > state.initial_height:
@@ -164,10 +199,21 @@ class BlockExecutor:
             raise ExecutionError("app returned wrong number of tx results")
 
         new_state = self._update_state(state, block_id, block, resp)
+        if self.evidence_pool is not None:
+            self.evidence_pool.mark_committed(
+                block.header.height, block.header.time.seconds,
+                block.evidence,
+            )
         self.state_store.save(new_state)
         self.app.commit()
         if self.mempool:
             self.mempool.update(block.header.height, block.data.txs)
+        if self.event_bus is not None:
+            # fireEvents (execution.go:707): NewBlock + per-tx events
+            self.event_bus.publish_new_block(block, resp)
+            self.event_bus.publish_new_block_header(block.header)
+            for tx, txr in zip(block.data.txs, resp.tx_results):
+                self.event_bus.publish_tx(block.header.height, tx, txr)
         return new_state
 
     def _update_state(

@@ -118,6 +118,12 @@ class Data:
         return merkle.hash_from_byte_slices(self.txs)
 
 
+def evidence_hash(evs: List) -> bytes:
+    """EvidenceData.Hash (types/evidence.go EvidenceList.Hash): merkle of
+    per-evidence hashes."""
+    return merkle.hash_from_byte_slices([ev.hash() for ev in evs])
+
+
 def commit_sig_proto(cs: CommitSig) -> bytes:
     body = pe.f_varint(1, cs.flag)
     body += pe.f_bytes(2, cs.validator_address)
@@ -140,6 +146,10 @@ class Block:
     header: Header
     data: Data
     last_commit: Optional[Commit] = None
+    # committed evidence (types/block.go:48 Evidence EvidenceData): list
+    # of DuplicateVoteEvidence / LightClientAttackEvidence, hashed into
+    # header.evidence_hash
+    evidence: List = field(default_factory=list)
 
     def hash(self) -> Optional[bytes]:
         return self.header.hash()
@@ -156,7 +166,7 @@ class Block:
         if not self.header.data_hash:
             self.header.data_hash = self.data.hash()
         if not self.header.evidence_hash:
-            self.header.evidence_hash = merkle.hash_from_byte_slices([])
+            self.header.evidence_hash = evidence_hash(self.evidence)
 
     def validate_basic(self) -> None:
         """types/block.go:48-101."""
@@ -169,5 +179,9 @@ class Block:
                 raise BlockError("wrong Header.LastCommitHash")
         if self.header.data_hash != self.data.hash():
             raise BlockError("wrong Header.DataHash")
+        if self.header.evidence_hash != evidence_hash(self.evidence):
+            raise BlockError("wrong Header.EvidenceHash")
+        for ev in self.evidence:
+            ev.validate_basic()
         if len(self.header.proposer_address) != tmhash.TRUNCATED_SIZE:
             raise BlockError("invalid proposer address size")

@@ -118,19 +118,25 @@ def header_from_j(j) -> Header:
 
 
 def block_to_json(b: Block) -> str:
+    from cometbft_tpu.types.evidence import evidence_to_j
+
     return json.dumps({
         "header": header_to_j(b.header),
         "txs": [t.hex() for t in b.data.txs],
         "last_commit": commit_to_j(b.last_commit),
+        "evidence": [evidence_to_j(ev) for ev in b.evidence],
     })
 
 
 def block_from_json(s: str) -> Block:
+    from cometbft_tpu.types.evidence import evidence_from_j
+
     j = json.loads(s)
     return Block(
         header=header_from_j(j["header"]),
         data=Data([bytes.fromhex(t) for t in j["txs"]]),
         last_commit=commit_from_j(j["last_commit"]),
+        evidence=[evidence_from_j(e) for e in j.get("evidence", [])],
     )
 
 
