@@ -1,0 +1,521 @@
+"""JSON-RPC server: HTTP POST/GET + WebSocket subscriptions.
+
+Reference: rpc/jsonrpc/server (HTTP + WebSocket JSON-RPC 2.0),
+rpc/core/routes.go:12-56 (route table: health, status, net_info,
+blockchain, block, block_by_hash, commit, validators, genesis,
+abci_info, abci_query, broadcast_tx_{sync,async,commit},
+unconfirmed_txs, subscribe/unsubscribe), rpc/core/events.go
+(subscriptions via the event bus).
+
+Implementation: stdlib ThreadingHTTPServer; the WebSocket side is a
+minimal RFC 6455 implementation (handshake + masked text frames) — no
+external dependencies exist in this image.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from cometbft_tpu.types import serde
+from cometbft_tpu.types.event_bus import EVENT_TX, TX_HASH_KEY
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(message)
+
+
+# --------------------------------------------------------------------------
+# route implementations (rpc/core/*)
+# --------------------------------------------------------------------------
+
+
+class Routes:
+    """The rpccore.Environment analog: reads node internals."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # -- info ---------------------------------------------------------------
+
+    def health(self):
+        return {}
+
+    def status(self):
+        n = self.node
+        latest = n.block_store.height()
+        blk = n.block_store.load_block(latest) if latest else None
+        pub = n.consensus.privval.pub_key() if n.consensus.privval else None
+        return {
+            "node_info": {
+                "id": n.switch.node_key.node_id if n.switch else "",
+                "network": n.consensus.state.chain_id,
+                "version": "cometbft-tpu/0.3",
+            },
+            "sync_info": {
+                "latest_block_height": latest,
+                "latest_block_hash":
+                    blk.hash().hex().upper() if blk else "",
+                "latest_app_hash":
+                    n.consensus.state.app_hash.hex().upper(),
+                "catching_up": n.blocksync_engine.is_running()
+                    if n.blocksync_engine else False,
+            },
+            "validator_info": {
+                "address": pub.address().hex().upper() if pub else "",
+                "pub_key": pub.data.hex() if pub else "",
+                "voting_power": 0 if pub is None else next(
+                    (v.voting_power
+                     for v in n.consensus.state.validators.validators
+                     if v.address == pub.address()), 0),
+            },
+        }
+
+    def net_info(self):
+        n = self.node
+        peers = []
+        if n.switch is not None:
+            for p in n.switch.peers.values():
+                peers.append({"node_id": p.peer_id})
+        return {"listening": n.switch is not None,
+                "n_peers": len(peers), "peers": peers}
+
+    def genesis(self):
+        st = self.node.consensus.state
+        return {"genesis": {
+            "chain_id": st.chain_id,
+            "initial_height": st.initial_height,
+        }}
+
+    # -- blocks -------------------------------------------------------------
+
+    def _height_arg(self, height) -> int:
+        latest = self.node.block_store.height()
+        if height is None or height == "":
+            return latest
+        h = int(height)
+        if h <= 0 or h > latest:
+            raise RPCError(-32603, f"height {h} not available "
+                                   f"(latest {latest})")
+        return h
+
+    def block(self, height=None):
+        h = self._height_arg(height)
+        blk = self.node.block_store.load_block(h)
+        if blk is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {"block_id": serde.bid_to_j(blk.block_id()),
+                "block": json.loads(serde.block_to_json(blk))}
+
+    def block_by_hash(self, hash):
+        blk = self.node.block_store.load_block_by_hash(bytes.fromhex(hash))
+        if blk is None:
+            raise RPCError(-32603, "block not found")
+        return {"block_id": serde.bid_to_j(blk.block_id()),
+                "block": json.loads(serde.block_to_json(blk))}
+
+    def blockchain(self, min_height=None, max_height=None):
+        latest = self.node.block_store.height()
+        maxh = int(max_height) if max_height else latest
+        minh = int(min_height) if min_height else max(1, maxh - 19)
+        metas = []
+        for h in range(min(maxh, latest), max(minh, 1) - 1, -1):
+            blk = self.node.block_store.load_block(h)
+            if blk is None:
+                continue
+            metas.append({
+                "block_id": serde.bid_to_j(blk.block_id()),
+                "header": serde.header_to_j(blk.header),
+                "num_txs": len(blk.data.txs),
+            })
+        return {"last_height": latest, "block_metas": metas}
+
+    def commit(self, height=None):
+        h = self._height_arg(height)
+        blk = self.node.block_store.load_block(h)
+        commit = self.node.block_store.load_seen_commit(h) or \
+            self.node.block_store.load_block_commit(h)
+        if blk is None or commit is None:
+            raise RPCError(-32603, f"no commit at height {h}")
+        return {
+            "signed_header": {
+                "header": serde.header_to_j(blk.header),
+                "commit": serde.commit_to_j(commit),
+            },
+            "canonical": True,
+        }
+
+    def validators(self, height=None, page=None, per_page=None):
+        h = self._height_arg(height)
+        vals = self.node.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        return {
+            "block_height": h,
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": {"type": v.pub_key.key_type,
+                                "value": v.pub_key.data.hex()},
+                    "voting_power": v.voting_power,
+                    "proposer_priority": v.proposer_priority,
+                }
+                for v in vals.validators
+            ],
+            "count": len(vals.validators),
+            "total": len(vals.validators),
+        }
+
+    # -- ABCI ---------------------------------------------------------------
+
+    def abci_info(self):
+        from cometbft_tpu.abci import types as abci
+
+        info = self.node.app.info(abci.RequestInfo())
+        return {"response": {
+            "data": info.data,
+            "last_block_height": info.last_block_height,
+            "last_block_app_hash": info.last_block_app_hash.hex(),
+        }}
+
+    def abci_query(self, path=None, data=None, height=None, prove=None):
+        from cometbft_tpu.abci import types as abci
+
+        resp = self.node.app.query(abci.RequestQuery(
+            data=bytes.fromhex(data) if data else b"",
+            path=path or "",
+        ))
+        return {"response": {
+            "code": resp.code,
+            "key": resp.key.hex() if resp.key else "",
+            "value": base64.b64encode(resp.value).decode()
+            if resp.value else "",
+            "log": resp.log,
+        }}
+
+    # -- txs ----------------------------------------------------------------
+
+    def _decode_tx(self, tx) -> bytes:
+        # accept base64 (reference encoding) or hex
+        try:
+            return base64.b64decode(tx, validate=True)
+        except Exception:
+            return bytes.fromhex(tx)
+
+    def broadcast_tx_sync(self, tx):
+        raw = self._decode_tx(tx)
+        resp = self.node.broadcast_tx(raw)
+        return {"code": resp.code, "data": "", "log": resp.log,
+                "hash": hashlib.sha256(raw).hexdigest().upper()}
+
+    def broadcast_tx_async(self, tx):
+        raw = self._decode_tx(tx)
+        import threading as _t
+
+        _t.Thread(target=self.node.broadcast_tx, args=(raw,),
+                  daemon=True).start()
+        return {"code": 0, "data": "", "log": "",
+                "hash": hashlib.sha256(raw).hexdigest().upper()}
+
+    def broadcast_tx_commit(self, tx, timeout: float = 30.0):
+        """CheckTx, then wait for the tx's DeliverTx event
+        (rpc/core/mempool.go BroadcastTxCommit)."""
+        raw = self._decode_tx(tx)
+        txhash = hashlib.sha256(raw).hexdigest().upper()
+        sub = self.node.event_bus.subscribe(
+            f"btc-{txhash}-{time.time()}",
+            f"{TX_HASH_KEY}='{txhash}'",
+        )
+        try:
+            check = self.node.broadcast_tx(raw)
+            if check.code != 0:
+                return {"check_tx": {"code": check.code, "log": check.log},
+                        "deliver_tx": {}, "hash": txhash, "height": 0}
+            msg = sub.next(timeout=timeout)
+            if msg is None:
+                raise RPCError(-32603, "timed out waiting for tx commit")
+            data = msg.data
+            return {
+                "check_tx": {"code": check.code, "log": check.log},
+                "tx_result": {"code": data["result"].code,
+                              "log": data["result"].log},
+                "hash": txhash,
+                "height": data["height"],
+            }
+        finally:
+            self.node.event_bus.pubsub.unsubscribe_all(
+                f"btc-{txhash}-{time.time()}"
+            )
+
+    def unconfirmed_txs(self, limit=None):
+        txs = self.node.mempool.reap(-1)
+        lim = int(limit) if limit else 30
+        return {"n_txs": len(txs), "total": len(txs),
+                "txs": [base64.b64encode(t).decode() for t in txs[:lim]]}
+
+    def num_unconfirmed_txs(self):
+        txs = self.node.mempool.reap(-1)
+        return {"n_txs": len(txs), "total": len(txs)}
+
+
+_ROUTES = [
+    "health", "status", "net_info", "genesis", "block", "block_by_hash",
+    "blockchain", "commit", "validators", "abci_info", "abci_query",
+    "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
+    "unconfirmed_txs", "num_unconfirmed_txs",
+]
+
+
+# --------------------------------------------------------------------------
+# HTTP + WebSocket plumbing
+# --------------------------------------------------------------------------
+
+
+def _event_to_json(msg):
+    """Render a pubsub Message for the wire."""
+    data = msg.data
+    out = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if hasattr(v, "hash") and hasattr(v, "header"):  # Block
+                out[k] = json.loads(serde.block_to_json(v))
+            elif hasattr(v, "chain_id") and hasattr(v, "height"):  # Header
+                out[k] = serde.header_to_j(v)
+            elif isinstance(v, bytes):
+                out[k] = base64.b64encode(v).decode()
+            elif hasattr(v, "__dict__"):
+                out[k] = {a: (b.hex() if isinstance(b, bytes) else b)
+                          for a, b in vars(v).items()
+                          if isinstance(b, (int, str, bytes, float))}
+            else:
+                out[k] = v
+    return {"query": None, "data": out,
+            "events": {k: v for k, v in msg.tags.items()}}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "cometbft-tpu-rpc"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    @property
+    def routes(self) -> Routes:
+        return self.server.routes  # type: ignore[attr-defined]
+
+    def _reply(self, obj, rid=None):
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": rid, "result": obj}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(self, code, message, rid=None, http=200):
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": message},
+        }).encode()
+        self.send_response(http)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _call(self, method: str, params: dict, rid):
+        if method not in _ROUTES:
+            self._reply_error(-32601, f"method {method!r} not found", rid)
+            return
+        try:
+            result = getattr(self.routes, method)(**(params or {}))
+            self._reply(result, rid)
+        except RPCError as e:
+            self._reply_error(e.code, str(e), rid)
+        except TypeError as e:
+            self._reply_error(-32602, f"invalid params: {e}", rid)
+        except Exception as e:  # noqa: BLE001
+            self._reply_error(-32603, f"internal error: {e}", rid)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/websocket":
+            self._websocket()
+            return
+        method = url.path.strip("/")
+        params = dict(parse_qsl(url.query))
+        # URI params arrive quoted like the reference's URI form
+        params = {k: v.strip('"') for k, v in params.items()}
+        self._call(method, params, -1)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length).decode())
+        except Exception:
+            self._reply_error(-32700, "parse error")
+            return
+        self._call(req.get("method", ""), req.get("params") or {},
+                   req.get("id"))
+
+    # -- WebSocket (RFC 6455 minimal) --------------------------------------
+
+    def _websocket(self):
+        key = self.headers.get("Sec-WebSocket-Key")
+        if not key:
+            self._reply_error(-32600, "not a websocket request", http=400)
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        conn = self.connection
+        conn.settimeout(0.2)
+        subscriber = f"ws-{id(self)}"
+        subs = []
+        bus = self.server.routes.node.event_bus  # type: ignore
+        try:
+            while not self.server.stopping:  # type: ignore
+                frame = self._ws_read(conn)
+                if frame is _CLOSED:
+                    break
+                if frame is not None:
+                    try:
+                        req = json.loads(frame)
+                        method = req.get("method")
+                        params = req.get("params") or {}
+                        if method == "subscribe":
+                            q = params.get("query", "")
+                            sub = bus.subscribe(subscriber, q)
+                            subs.append(sub)
+                            self._ws_send(conn, json.dumps({
+                                "jsonrpc": "2.0", "id": req.get("id"),
+                                "result": {},
+                            }))
+                        elif method == "unsubscribe_all":
+                            bus.unsubscribe_all(subscriber)
+                            subs.clear()
+                            self._ws_send(conn, json.dumps({
+                                "jsonrpc": "2.0", "id": req.get("id"),
+                                "result": {},
+                            }))
+                        else:
+                            self._ws_send(conn, json.dumps({
+                                "jsonrpc": "2.0", "id": req.get("id"),
+                                "error": {"code": -32601,
+                                          "message": "unknown ws method"},
+                            }))
+                    except Exception as e:  # noqa: BLE001
+                        self._ws_send(conn, json.dumps({
+                            "jsonrpc": "2.0", "id": None,
+                            "error": {"code": -32700, "message": str(e)},
+                        }))
+                for sub in subs:
+                    msg = sub.next(timeout=0)
+                    while msg is not None:
+                        self._ws_send(conn, json.dumps({
+                            "jsonrpc": "2.0", "id": -1,
+                            "result": _event_to_json(msg),
+                        }))
+                        msg = sub.next(timeout=0)
+        finally:
+            bus.unsubscribe_all(subscriber)
+
+    def _ws_read(self, conn):
+        try:
+            hdr = self._recv_exact(conn, 2)
+        except socket.timeout:
+            return None
+        except OSError:
+            return _CLOSED
+        if hdr is None:
+            return _CLOSED
+        opcode = hdr[0] & 0x0F
+        masked = hdr[1] & 0x80
+        ln = hdr[1] & 0x7F
+        if ln == 126:
+            ln = struct.unpack(">H", self._recv_exact(conn, 2))[0]
+        elif ln == 127:
+            ln = struct.unpack(">Q", self._recv_exact(conn, 8))[0]
+        mask = self._recv_exact(conn, 4) if masked else b"\x00" * 4
+        data = self._recv_exact(conn, ln) if ln else b""
+        if masked and data:
+            data = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        if opcode == 0x8:  # close
+            return _CLOSED
+        if opcode in (0x1, 0x2):
+            return data.decode()
+        return None  # ping/pong/continuation ignored
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    @staticmethod
+    def _ws_send(conn, text: str):
+        data = text.encode()
+        hdr = bytes([0x81])
+        n = len(data)
+        if n < 126:
+            hdr += bytes([n])
+        elif n < 65536:
+            hdr += bytes([126]) + struct.pack(">H", n)
+        else:
+            hdr += bytes([127]) + struct.pack(">Q", n)
+        conn.sendall(hdr + data)
+
+
+_CLOSED = object()
+
+
+class RPCServer:
+    """rpc/jsonrpc server lifecycle wrapper."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.routes = Routes(node)  # type: ignore[attr-defined]
+        self.httpd.stopping = False  # type: ignore[attr-defined]
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="rpc-http"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.stopping = True  # type: ignore[attr-defined]
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,184 @@
+"""RPC server + EventBus: an external HTTP client drives a node, a light
+client syncs over RPC, WebSocket subscriptions stream events.
+
+Reference: rpc/core/routes.go route surface + rpc/jsonrpc server tests.
+"""
+import base64
+import hashlib
+import json
+import socket
+import struct
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.libs.pubsub import PubSub, Query
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.rpc.client import HTTPClient, light_provider
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+FAST = TimeoutParams(
+    propose=0.4, propose_delta=0.1,
+    prevote=0.2, prevote_delta=0.1,
+    precommit=0.2, precommit_delta=0.1,
+    commit=0.01,
+)
+
+
+def test_query_language():
+    q = Query("tm.event='NewBlock' AND tx.height=5")
+    assert q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+    assert not q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+    q2 = Query("tx.hash EXISTS")
+    assert q2.matches({"tx.hash": ["AB"]})
+    assert not q2.matches({})
+
+
+def test_pubsub_drop_on_full():
+    ps = PubSub()
+    sub = ps.subscribe("s", "k='v'", capacity=2)
+    for _ in range(5):
+        ps.publish("x", {"k": ["v"]})
+    got = 0
+    while sub.next(timeout=0):
+        got += 1
+    assert got == 2  # dropped, not blocked
+
+
+@pytest.fixture()
+def rpc_node(tmp_path):
+    priv = PrivKey.generate(b"\x09" * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    state = State.make_genesis("rpc-chain", vals)
+    node = Node(KVStoreApplication(), state, privval=FilePV(priv),
+                home=str(tmp_path / "n0"), timeouts=FAST)
+    node.start()
+    url = node.rpc_listen()
+    try:
+        assert node.consensus.wait_for_height(2, timeout=60)
+        yield node, url
+    finally:
+        node.stop()
+
+
+def test_rpc_core_routes(rpc_node):
+    node, url = rpc_node
+    c = HTTPClient(url)
+
+    st = c.status()
+    assert st["sync_info"]["latest_block_height"] >= 2
+    assert st["node_info"]["network"] == "rpc-chain"
+
+    b = c.block(2)
+    assert b["block"]["header"]["height"] == 2
+    bh = c.call("block_by_hash", hash=b["block_id"]["hash"])
+    assert bh["block"]["header"]["height"] == 2
+
+    cm = c.commit(2)
+    assert cm["signed_header"]["commit"]["height"] == 2
+
+    v = c.validators(2)
+    assert v["count"] == 1
+
+    bc = c.call("blockchain")
+    assert bc["last_height"] >= 2 and bc["block_metas"]
+
+    assert c.call("health") == {}
+    ni = c.call("net_info")
+    assert ni["n_peers"] == 0
+
+    ai = c.call("abci_info")
+    assert "response" in ai
+
+    # tx through the full pipeline
+    res = c.broadcast_tx_commit(b"rpckey=rpcval")
+    assert res["tx_result"]["code"] == 0 and res["height"] > 0
+    q = c.abci_query(b"rpckey")
+    assert base64.b64decode(q["response"]["value"]) == b"rpcval"
+
+    # URI (GET) form
+    with urllib.request.urlopen(f"{url}/status", timeout=5) as r:
+        j = json.loads(r.read().decode())
+    assert j["result"]["sync_info"]["latest_block_height"] >= 2
+
+    # error path
+    with pytest.raises(Exception):
+        c.block(10_000)
+
+
+def test_light_client_syncs_over_rpc(rpc_node):
+    node, url = rpc_node
+    from cometbft_tpu.light import client as lc
+
+    assert node.consensus.wait_for_height(4, timeout=60)
+    provider = light_provider("rpc-chain", url)
+    c = lc.Client("rpc-chain", provider, trusting_period=1e6)
+    c.trust_light_block(provider.light_block(1))
+    target = node.height()
+    lb = c.verify_light_block_at_height(target)
+    assert lb.signed_header.header.height == target
+    # the verified header matches the node's own block hash
+    assert lb.signed_header.header.hash() == \
+        node.block_store.load_block(target).hash()
+
+
+def _ws_handshake(host, port):
+    s = socket.create_connection((host, port), timeout=10)
+    key = base64.b64encode(b"0123456789abcdef").decode()
+    s.sendall((
+        f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+        "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+        f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+    ).encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(4096)
+    assert b"101" in buf.split(b"\r\n", 1)[0]
+    return s
+
+
+def _ws_send(s, text):
+    data = text.encode()
+    mask = b"\x01\x02\x03\x04"
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+    assert len(data) < 126
+    s.sendall(bytes([0x81, 0x80 | len(data)]) + mask + masked)
+
+
+def _ws_recv(s, timeout=20.0):
+    s.settimeout(timeout)
+    hdr = s.recv(2)
+    ln = hdr[1] & 0x7F
+    if ln == 126:
+        ln = struct.unpack(">H", s.recv(2))[0]
+    elif ln == 127:
+        ln = struct.unpack(">Q", s.recv(8))[0]
+    data = b""
+    while len(data) < ln:
+        data += s.recv(ln - len(data))
+    return data.decode()
+
+
+def test_websocket_subscription(rpc_node):
+    node, url = rpc_node
+    host, port = url[len("http://"):].split(":")
+    s = _ws_handshake(host, int(port))
+    try:
+        _ws_send(s, json.dumps({
+            "jsonrpc": "2.0", "id": 1, "method": "subscribe",
+            "params": {"query": "tm.event='NewBlock'"},
+        }))
+        ack = json.loads(_ws_recv(s))
+        assert ack["id"] == 1 and "result" in ack
+        ev = json.loads(_ws_recv(s))
+        assert ev["result"]["events"]["tm.event"] == ["NewBlock"]
+        assert ev["result"]["data"]["block"]["header"]["height"] > 0
+    finally:
+        s.close()
