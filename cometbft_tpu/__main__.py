@@ -1,0 +1,3 @@
+from cometbft_tpu.cmd.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,244 @@
+"""Operator CLI: init / start / testnet / show-node-id / reset.
+
+Reference: cmd/cometbft/commands/ (cobra): init.go, run_node.go,
+testnet.go, show_node_id.go, reset.go. `python -m cometbft_tpu <cmd>`.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import signal
+import sys
+import time
+
+from cometbft_tpu.config.config import (
+    Config,
+    default_home,
+    load_config,
+    save_config,
+)
+
+
+def _home_arg(p):
+    p.add_argument("--home", default=default_home(),
+                   help="node home directory")
+
+
+def _config_path(home):
+    return os.path.join(home, "config", "config.toml")
+
+
+def cmd_init(args) -> int:
+    """init.go: write config.toml, genesis.json, node_key.json,
+    priv_validator_key.json."""
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    home = args.home
+    cfgdir = os.path.join(home, "config")
+    datadir = os.path.join(home, "data")
+    os.makedirs(cfgdir, exist_ok=True)
+    os.makedirs(datadir, exist_ok=True)
+
+    cfg = Config()
+    if args.chain_id:
+        cfg.base.chain_id = args.chain_id
+    cfg.crypto.verifier = args.verifier
+    save_config(cfg, _config_path(home))
+
+    pv = FilePV.generate(cfgdir) if not os.path.exists(
+        os.path.join(cfgdir, "priv_validator_key.json")
+    ) else FilePV.load(cfgdir)
+    NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json"))
+
+    gpath = os.path.join(cfgdir, "genesis.json")
+    if not os.path.exists(gpath):
+        doc = GenesisDoc(
+            chain_id=cfg.base.chain_id,
+            genesis_time=Timestamp.now(),
+            validators=[GenesisValidator(pv.pub_key(), 10, "validator")],
+        )
+        doc.save_as(gpath)
+    print(f"Initialized node in {home}")
+    return 0
+
+
+def build_node(home: str, cfg=None):
+    """Assemble a Node from a home directory (run_node.go -> NewNode)."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    cfg = cfg or load_config(_config_path(home))
+    cfgdir = os.path.join(home, "config")
+    doc = GenesisDoc.from_file(os.path.join(cfgdir, "genesis.json"))
+    if cfg.base.proxy_app != "kvstore":
+        raise SystemExit(
+            f"unknown proxy_app {cfg.base.proxy_app!r} (in-process apps: "
+            f"kvstore; socket ABCI arrives with the abci server)"
+        )
+    node = Node(
+        KVStoreApplication(),
+        doc.make_state(),
+        privval=FilePV.load(cfgdir),
+        home=os.path.join(home, "data"),
+        timeouts=cfg.consensus.timeout_params(),
+        batch_fn=cfg.crypto.batch_fn(),
+        p2p=True,
+        node_key=NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json")),
+        blocksync=cfg.base.blocksync,
+    )
+    return node, cfg
+
+
+def _parse_addr(laddr: str):
+    hostport = laddr.split("://", 1)[-1]
+    host, _, port = hostport.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+def cmd_start(args) -> int:
+    """run_node.go: assemble, listen, dial persistent peers, serve RPC."""
+    from cometbft_tpu.p2p.key import NetAddress
+
+    node, cfg = build_node(args.home)
+    host, port = _parse_addr(cfg.p2p.laddr)
+    node.start()
+    addr = node.listen(host, port)
+    print(f"p2p listening on {addr.host}:{addr.port} (id {addr.node_id})")
+    if cfg.rpc.enabled:
+        rh, rp = _parse_addr(cfg.rpc.laddr)
+        url = node.rpc_listen(rh, rp)
+        print(f"rpc listening on {url}")
+    for peer in filter(None, cfg.p2p.persistent_peers.split(",")):
+        pid, hostport = peer.strip().split("@")
+        h, _, p = hostport.rpartition(":")
+        node.dial(NetAddress(pid, h, int(p)))
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop and (args.run_for <= 0
+                            or time.time() < args._t0 + args.run_for):
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """testnet.go: generate n validator home dirs wired to each other."""
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    n = args.v
+    homes = [os.path.join(args.output, f"node{i}") for i in range(n)]
+    pvs, keys = [], []
+    for home in homes:
+        cfgdir = os.path.join(home, "config")
+        os.makedirs(cfgdir, exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pvs.append(FilePV.generate(cfgdir))
+        keys.append(NodeKey.load_or_gen(
+            os.path.join(cfgdir, "node_key.json")))
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "cbt-testnet",
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(pv.pub_key(), 10, f"node{i}")
+                    for i, pv in enumerate(pvs)],
+    )
+    # two ports per node (p2p, rpc) so the ranges can never collide
+    # (testnet.go allocates per-node port pairs the same way)
+    base_p2p, base_rpc = args.p2p_port, args.rpc_port
+    p2p_port = lambda i: base_p2p + 2 * i
+    rpc_port = lambda i: base_rpc + 2 * i
+    for i, home in enumerate(homes):
+        cfg = Config()
+        cfg.base.chain_id = doc.chain_id
+        cfg.base.blocksync = False  # all start at genesis together
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port(i)}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port(i)}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"{keys[j].node_id}@127.0.0.1:{p2p_port(j)}"
+            for j in range(n) if j != i
+        )
+        save_config(cfg, _config_path(home))
+        doc.save_as(os.path.join(home, "config", "genesis.json"))
+    print(f"Generated {n}-node testnet in {args.output}")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from cometbft_tpu.p2p.key import NodeKey
+
+    nk = NodeKey.load_or_gen(
+        os.path.join(args.home, "config", "node_key.json"))
+    print(nk.node_id)
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """reset.go unsafe-reset-all: wipe data, keep config + keys."""
+    data = os.path.join(args.home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    state = os.path.join(args.home, "config", "priv_validator_state.json")
+    if os.path.exists(state):
+        os.remove(state)
+    print(f"Reset {data}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cometbft_tpu",
+        description="TPU-native CometBFT: BFT consensus with device-"
+                    "batched signature verification",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize a node home directory")
+    _home_arg(p)
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--verifier", default="tpu", choices=["tpu", "cpu"])
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run a node")
+    _home_arg(p)
+    p.add_argument("--run-for", type=float, default=0,
+                   help="exit after N seconds (0 = forever)")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("testnet", help="generate a localhost testnet")
+    p.add_argument("--v", type=int, default=4, help="validator count")
+    p.add_argument("--output", default="./testnet")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--p2p-port", type=int, default=26656)
+    p.add_argument("--rpc-port", type=int, default=26657)
+    p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser("show-node-id", help="print this node's p2p id")
+    _home_arg(p)
+    p.set_defaults(fn=cmd_show_node_id)
+
+    p = sub.add_parser("unsafe-reset-all",
+                       help="wipe chain data (keeps keys + config)")
+    _home_arg(p)
+    p.set_defaults(fn=cmd_reset)
+
+    args = parser.parse_args(argv)
+    args._t0 = time.time()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Typed node configuration + TOML persistence.
+
+Reference: config/config.go:76-1312 (Config with Base/RPC/P2P/Mempool/
+Blocksync/Consensus/Storage sections, ValidateBasic per section),
+config/toml.go (template render). New here per SURVEY §5: the `[crypto]`
+section selecting the signature-verification backend — `verifier =
+"tpu"` routes commit verification through the Pallas device kernels,
+"cpu" forces the host path.
+"""
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = "cometbft-tpu-chain"
+    moniker: str = "node"
+    proxy_app: str = "kvstore"      # in-process app by name
+    blocksync: bool = True          # sync before joining consensus
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    enabled: bool = True
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    persistent_peers: str = ""      # comma-separated id@host:port
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    cache_size: int = 10000
+    recheck: bool = True
+
+
+@dataclass
+class ConsensusConfig:
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+
+    def timeout_params(self):
+        from cometbft_tpu.consensus.ticker import TimeoutParams
+
+        return TimeoutParams(
+            propose=self.timeout_propose,
+            propose_delta=self.timeout_propose_delta,
+            prevote=self.timeout_prevote,
+            prevote_delta=self.timeout_prevote_delta,
+            precommit=self.timeout_precommit,
+            precommit_delta=self.timeout_precommit_delta,
+            commit=self.timeout_commit,
+        )
+
+
+@dataclass
+class CryptoConfig:
+    """SURVEY §5: the TPU verifier seam lives in config."""
+
+    verifier: str = "tpu"   # "tpu" | "cpu"
+    device: str = ""        # informational (e.g. "v5e-1")
+
+    def batch_fn(self):
+        if self.verifier == "cpu":
+            return None
+        from cometbft_tpu.types import validation
+
+        return validation.device_batch_fn()
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
+
+    def validate_basic(self) -> None:
+        if not self.base.chain_id:
+            raise ConfigError("chain_id must not be empty")
+        if self.crypto.verifier not in ("tpu", "cpu"):
+            raise ConfigError(
+                f"[crypto] verifier must be tpu|cpu, "
+                f"got {self.crypto.verifier!r}"
+            )
+        for name in ("timeout_propose", "timeout_prevote",
+                     "timeout_precommit", "timeout_commit"):
+            if getattr(self.consensus, name) < 0:
+                raise ConfigError(f"[consensus] {name} must be >= 0")
+
+
+def _render(cfg: Config) -> str:
+    """TOML template (config/toml.go analog)."""
+
+    def v(x):
+        if isinstance(x, bool):
+            return "true" if x else "false"
+        if isinstance(x, (int, float)):
+            return repr(x)
+        return f'"{x}"'
+
+    out = ["# cometbft-tpu node configuration\n"]
+    for section, obj in [
+        ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
+        ("mempool", cfg.mempool), ("consensus", cfg.consensus),
+        ("crypto", cfg.crypto),
+    ]:
+        out.append(f"[{section}]")
+        for k, val in vars(obj).items():
+            out.append(f"{k} = {v(val)}")
+        out.append("")
+    return "\n".join(out)
+
+
+def save_config(cfg: Config, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(_render(cfg))
+
+
+def load_config(path: str) -> Config:
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    cfg = Config()
+    for section, obj in [
+        ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
+        ("mempool", cfg.mempool), ("consensus", cfg.consensus),
+        ("crypto", cfg.crypto),
+    ]:
+        for k, val in doc.get(section, {}).items():
+            if not hasattr(obj, k):
+                raise ConfigError(f"unknown key [{section}] {k}")
+            setattr(obj, k, val)
+    cfg.validate_basic()
+    return cfg
+
+
+def default_home() -> str:
+    return os.path.expanduser(os.environ.get("CBT_HOME", "~/.cometbft-tpu"))

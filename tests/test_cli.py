@@ -1,0 +1,87 @@
+"""CLI + config: init/start/testnet drive real validators from home dirs.
+
+Reference: cmd/cometbft/commands (init.go, run_node.go, testnet.go) and
+config/config.go ValidateBasic.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.config.config import (
+    Config,
+    ConfigError,
+    load_config,
+    save_config,
+)
+from cometbft_tpu.cmd import cli
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = Config()
+    cfg.base.chain_id = "roundtrip"
+    cfg.crypto.verifier = "cpu"
+    cfg.consensus.timeout_propose = 1.5
+    p = str(tmp_path / "config.toml")
+    save_config(cfg, p)
+    got = load_config(p)
+    assert got.base.chain_id == "roundtrip"
+    assert got.crypto.verifier == "cpu"
+    assert got.consensus.timeout_propose == 1.5
+
+    cfg.crypto.verifier = "gpu"
+    with pytest.raises(ConfigError):
+        cfg.validate_basic()
+
+
+def test_init_start_rpc(tmp_path):
+    """`init` then `start`: the validator commits blocks and serves RPC
+    (the round-2 verdict item 8 done-condition)."""
+    home = str(tmp_path / "node")
+    assert cli.main(["init", "--home", home, "--chain-id", "cli-chain",
+                     "--verifier", "cpu"]) == 0
+    # speed up consensus + pick free ports for the test
+    cfg = load_config(os.path.join(home, "config", "config.toml"))
+    cfg.consensus.timeout_propose = 0.4
+    cfg.consensus.timeout_commit = 0.01
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.blocksync = False
+    save_config(cfg, os.path.join(home, "config", "config.toml"))
+
+    node, cfg = cli.build_node(home)
+    node.start()
+    try:
+        url = node.rpc_listen()
+        assert node.consensus.wait_for_height(2, timeout=60)
+        with urllib.request.urlopen(f"{url}/status", timeout=5) as r:
+            j = json.loads(r.read().decode())
+        assert j["result"]["sync_info"]["latest_block_height"] >= 2
+        assert j["result"]["node_info"]["network"] == "cli-chain"
+    finally:
+        node.stop()
+
+
+def test_testnet_generation(tmp_path):
+    out = str(tmp_path / "net")
+    assert cli.main(["testnet", "--v", "3", "--output", out,
+                     "--chain-id", "net-chain"]) == 0
+    geneses = set()
+    for i in range(3):
+        home = os.path.join(out, f"node{i}")
+        cfg = load_config(os.path.join(home, "config", "config.toml"))
+        assert cfg.base.chain_id == "net-chain"
+        peers = cfg.p2p.persistent_peers.split(",")
+        assert len(peers) == 2  # wired to the other two
+        with open(os.path.join(home, "config", "genesis.json")) as f:
+            geneses.add(f.read())
+    assert len(geneses) == 1  # identical genesis everywhere
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    doc = GenesisDoc.from_file(
+        os.path.join(out, "node0", "config", "genesis.json"))
+    assert len(doc.validators) == 3
