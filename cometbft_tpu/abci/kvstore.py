@@ -135,6 +135,7 @@ class KVStoreApplication(abci.Application):
         self.state = self.staged
         self.height = self._pending_height
         self.app_hash = self._pending_hash
+        self._maybe_snapshot()
         return abci.ResponseCommit()
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
@@ -143,3 +144,71 @@ class KVStoreApplication(abci.Application):
             key=req.data, value=v, height=self.height,
             log="exists" if v else "does not exist",
         )
+
+    # -- state-sync snapshots (kvstore.go snapshot support) -----------------
+
+    SNAPSHOT_CHUNK_SIZE = 64 * 1024
+
+    def enable_snapshots(self, interval: int) -> None:
+        """Take a snapshot every `interval` heights (config
+        [statesync] snapshot-interval analog)."""
+        self._snapshot_interval = interval
+        self._snapshots = {}
+
+    def _maybe_snapshot(self) -> None:
+        interval = getattr(self, "_snapshot_interval", 0)
+        if not interval or self.height == 0 or self.height % interval:
+            return
+        doc = json.dumps({
+            "height": self.height,
+            "app_hash": self.app_hash.hex(),
+            "state": {k.hex(): v.hex() for k, v in self.state.items()},
+        }).encode()
+        chunks = [doc[i:i + self.SNAPSHOT_CHUNK_SIZE]
+                  for i in range(0, max(len(doc), 1),
+                                 self.SNAPSHOT_CHUNK_SIZE)]
+        self._snapshots[self.height] = chunks
+        # keep the most recent few (kvstore keeps a bounded set)
+        for h in sorted(self._snapshots)[:-3]:
+            del self._snapshots[h]
+
+    def list_snapshots(self):
+        out = []
+        for h, chunks in sorted(getattr(self, "_snapshots", {}).items()):
+            out.append(abci.Snapshot(
+                height=h, format=1, chunks=len(chunks),
+                hash=hashlib.sha256(b"".join(chunks)).digest(),
+            ))
+        return out
+
+    def offer_snapshot(self, snapshot: abci.Snapshot) -> bool:
+        if snapshot.format != 1 or snapshot.chunks < 1:
+            return False
+        self._restore = {"snapshot": snapshot, "chunks": [None] * snapshot.chunks}
+        return True
+
+    def load_snapshot_chunk(self, height, fmt, chunk) -> bytes:
+        chunks = getattr(self, "_snapshots", {}).get(height)
+        if chunks is None or fmt != 1 or not 0 <= chunk < len(chunks):
+            return b""
+        return chunks[chunk]
+
+    def apply_snapshot_chunk(self, index, chunk, sender) -> bool:
+        r = getattr(self, "_restore", None)
+        if r is None or not 0 <= index < len(r["chunks"]):
+            return False
+        r["chunks"][index] = chunk
+        if any(c is None for c in r["chunks"]):
+            return True
+        blob = b"".join(r["chunks"])
+        if hashlib.sha256(blob).digest() != r["snapshot"].hash:
+            self._restore = None
+            return False
+        doc = json.loads(blob.decode())
+        self.state = {bytes.fromhex(k): bytes.fromhex(v)
+                      for k, v in doc["state"].items()}
+        self.height = doc["height"]
+        self.app_hash = bytes.fromhex(doc["app_hash"])
+        self.staged = dict(self.state)
+        self._restore = None
+        return True

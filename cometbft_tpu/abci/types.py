@@ -24,6 +24,17 @@ class ValidatorUpdate:
 
 
 @dataclass
+class Snapshot:
+    """abci Snapshot (proto/tendermint/abci Snapshot)."""
+
+    height: int = 0
+    format: int = 1
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+@dataclass
 class RequestInfo:
     version: str = ""
     block_version: int = 0
@@ -191,11 +202,12 @@ class Application:
     def verify_vote_extension(self, height, round_, ext: bytes) -> bool:
         return True
 
-    # state-sync snapshots (stubs until statesync lands)
-    def list_snapshots(self):
+    # state-sync snapshots (abci/types/application.go:9 ListSnapshots/
+    # OfferSnapshot/LoadSnapshotChunk/ApplySnapshotChunk)
+    def list_snapshots(self) -> list:
         return []
 
-    def offer_snapshot(self, snapshot) -> bool:
+    def offer_snapshot(self, snapshot: "Snapshot") -> bool:
         return False
 
     def load_snapshot_chunk(self, height, fmt, chunk) -> bytes:

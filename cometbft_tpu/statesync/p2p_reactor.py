@@ -1,0 +1,103 @@
+"""Statesync over p2p: snapshot discovery + chunk transfer.
+
+Reference: statesync/reactor.go — SnapshotChannel 0x60 / ChunkChannel
+0x61, SnapshotsRequest/SnapshotsResponse, ChunkRequest/ChunkResponse.
+Serving side answers from the local app; syncing side feeds the Syncer.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from typing import List, Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.switch import Peer, Reactor
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+
+class StatesyncP2PReactor(Reactor):
+    def __init__(self, app: abci.Application, syncer=None):
+        super().__init__("STATESYNC")
+        self.app = app
+        self.syncer = syncer  # None on serve-only nodes
+        self._pending = {}    # (height, fmt, idx) -> [Event, data]
+        self._lock = threading.Lock()
+
+    def channel_descriptors(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=100,
+                              recv_message_capacity=32 * 1024 * 1024),
+        ]
+
+    def add_peer(self, peer: Peer) -> None:
+        if self.syncer is not None:
+            peer.send(SNAPSHOT_CHANNEL,
+                      json.dumps({"t": "snapshots_req"}).encode())
+
+    # -- chunk fetch for the Syncer ---------------------------------------
+
+    def _fetch_chunk(self, peer: Peer, snapshot: abci.Snapshot,
+                     idx: int, timeout: float = 10.0) -> Optional[bytes]:
+        key = (snapshot.height, snapshot.format, idx)
+        ev = threading.Event()
+        with self._lock:
+            self._pending[key] = [ev, None]
+        peer.send(CHUNK_CHANNEL, json.dumps({
+            "t": "chunk_req", "h": snapshot.height,
+            "f": snapshot.format, "i": idx,
+        }).encode())
+        ok = ev.wait(timeout)
+        with self._lock:
+            _, data = self._pending.pop(key, (None, None))
+        return data if ok else None
+
+    # -- inbound -----------------------------------------------------------
+
+    def receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        try:
+            j = json.loads(msg.decode())
+            t = j.get("t")
+            if t == "snapshots_req":
+                for s in self.app.list_snapshots():
+                    peer.send(SNAPSHOT_CHANNEL, json.dumps({
+                        "t": "snapshot", "h": s.height, "f": s.format,
+                        "c": s.chunks, "hash": s.hash.hex(),
+                        "m": s.metadata.hex(),
+                    }).encode())
+            elif t == "snapshot":
+                if self.syncer is not None:
+                    snap = abci.Snapshot(
+                        height=int(j["h"]), format=int(j["f"]),
+                        chunks=int(j["c"]), hash=bytes.fromhex(j["hash"]),
+                        metadata=bytes.fromhex(j.get("m", "")),
+                    )
+                    self.syncer.add_snapshot(
+                        snap,
+                        lambda i, p=peer, s=snap: self._fetch_chunk(p, s, i),
+                    )
+            elif t == "chunk_req":
+                data = self.app.load_snapshot_chunk(
+                    int(j["h"]), int(j["f"]), int(j["i"])
+                )
+                peer.send(CHUNK_CHANNEL, json.dumps({
+                    "t": "chunk", "h": j["h"], "f": j["f"], "i": j["i"],
+                    "data": base64.b64encode(data).decode(),
+                }).encode())
+            elif t == "chunk":
+                key = (int(j["h"]), int(j["f"]), int(j["i"]))
+                with self._lock:
+                    entry = self._pending.get(key)
+                    if entry is not None:
+                        entry[1] = base64.b64decode(j["data"])
+                        entry[0].set()
+            else:
+                raise ValueError(f"unknown statesync message {t!r}")
+        except Exception as e:  # noqa: BLE001 - malformed peer message
+            self.switch.stop_peer_for_error(peer, f"bad statesync msg: {e}")

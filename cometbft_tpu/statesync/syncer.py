@@ -1,0 +1,152 @@
+"""Statesync: bootstrap a fresh node from an application snapshot.
+
+Reference: statesync/syncer.go — SyncAny (:145) discovers snapshots,
+offers them to the app (:322 OfferSnapshot), downloads + applies chunks
+(:358,:415), verifies the restored app hash against a light block, and
+builds the post-restore State; stateprovider.go:40-76 embeds a light
+client to fetch trusted headers/validator sets.
+
+The snapshot/chunk transport is pluggable: the p2p reactor
+(statesync/p2p_reactor.py) or any provider callable (tests).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.params import ConsensusParams
+
+_log = logging.getLogger(__name__)
+
+
+class StateSyncError(Exception):
+    pass
+
+
+class LightStateProvider:
+    """stateprovider.go: trusted State + Commit via a light client.
+
+    The light client verifies every header it hands out (bisection from
+    a trusted root), so statesync inherits light-client security."""
+
+    def __init__(self, light_client, now=None):
+        self.lc = light_client
+        self.now = now
+
+    def state_at(self, height: int) -> State:
+        """State after `height` is applied (stateprovider.go State):
+        needs light blocks h, h+1, h+2 for last/current/next valsets."""
+        lb_last = self.lc.verify_light_block_at_height(height, now=self.now)
+        lb_cur = self.lc.verify_light_block_at_height(
+            height + 1, now=self.now
+        )
+        lb_next = self.lc.verify_light_block_at_height(
+            height + 2, now=self.now
+        )
+        hdr = lb_last.signed_header.header
+        bid = BlockID(hdr.hash(), PartSetHeader(1, hdr.hash()))
+        return State(
+            chain_id=hdr.chain_id,
+            initial_height=1,
+            last_block_height=height,
+            last_block_id=bid,
+            last_block_time=hdr.time,
+            validators=lb_cur.validator_set.copy(),
+            next_validators=lb_next.validator_set.copy(),
+            last_validators=lb_last.validator_set.copy(),
+            last_height_validators_changed=height + 1,
+            consensus_params=ConsensusParams(),
+            app_hash=lb_cur.signed_header.header.app_hash,
+            last_results_hash=lb_cur.signed_header.header.last_results_hash,
+        )
+
+    def commit_at(self, height: int):
+        lb = self.lc.verify_light_block_at_height(height, now=self.now)
+        return lb.signed_header.commit
+
+
+class Syncer:
+    """SyncAny (syncer.go:145) over pluggable snapshot sources."""
+
+    def __init__(self, app: abci.Application, state_provider,
+                 chunk_timeout: float = 10.0):
+        self.app = app
+        self.state_provider = state_provider
+        self.chunk_timeout = chunk_timeout
+        # snapshot discovery: {(height, format): (snapshot, fetch_chunk)}
+        self._snapshots: Dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+        self._have = threading.Event()
+
+    def add_snapshot(self, snapshot: abci.Snapshot,
+                     fetch_chunk: Callable[[int], Optional[bytes]]) -> None:
+        with self._lock:
+            self._snapshots[(snapshot.height, snapshot.format)] = (
+                snapshot, fetch_chunk
+            )
+        self._have.set()
+
+    def sync_any(self, discovery_time: float = 5.0) -> State:
+        """Try the best discovered snapshot; on failure fall through to
+        the next (syncer.go SyncAny retry loop)."""
+        deadline = time.time() + discovery_time
+        attempts: Dict[tuple, int] = {}
+        while True:
+            with self._lock:
+                candidates = sorted(
+                    self._snapshots.values(),
+                    key=lambda t: -t[0].height,
+                )
+            for snapshot, fetch in candidates:
+                key = (snapshot.height, snapshot.format)
+                try:
+                    return self._sync_one(snapshot, fetch)
+                except Exception as e:  # noqa: BLE001 - ANY failure falls
+                    # through to the next candidate: provider errors are
+                    # often transient (e.g. the chain hasn't produced
+                    # height+2 yet, which state_at needs), so each
+                    # snapshot gets a few tries before being dropped
+                    attempts[key] = attempts.get(key, 0) + 1
+                    _log.warning("snapshot h=%d failed (try %d): %s",
+                                 snapshot.height, attempts[key], e)
+                    if attempts[key] >= 3:
+                        with self._lock:
+                            self._snapshots.pop(key, None)
+            if time.time() > deadline:
+                raise StateSyncError(
+                    "no usable snapshot discovered in time"
+                )
+            self._have.wait(timeout=0.5)
+            self._have.clear()
+
+    def _sync_one(self, snapshot: abci.Snapshot, fetch_chunk) -> State:
+        # trusted target state FIRST: the app hash to verify against
+        # comes from the light client, never from the snapshot sender
+        state = self.state_provider.state_at(snapshot.height)
+        if not self.app.offer_snapshot(snapshot):
+            raise StateSyncError("app rejected snapshot offer")
+        for i in range(snapshot.chunks):
+            chunk = fetch_chunk(i)
+            if chunk is None:
+                raise StateSyncError(f"chunk {i} unavailable")
+            if not self.app.apply_snapshot_chunk(i, chunk, ""):
+                raise StateSyncError(f"app rejected chunk {i}")
+        # verify the restored app (syncer.go verifyApp): height + hash
+        # must match the light-client-trusted header
+        info = self.app.info(abci.RequestInfo())
+        if info.last_block_height != snapshot.height:
+            raise StateSyncError(
+                f"app restored height {info.last_block_height}, "
+                f"want {snapshot.height}"
+            )
+        if info.last_block_app_hash != state.app_hash:
+            raise StateSyncError(
+                "restored app hash does not match trusted header"
+            )
+        return state
