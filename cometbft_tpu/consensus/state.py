@@ -131,6 +131,9 @@ class ConsensusState(BaseService):
         # the evidence reactor gossip what we found locally
         self.evidence_pool = None
         self.on_evidence: Optional[Callable] = None
+        # observability (consensus/metrics.go:24-91 analog); set by Node
+        self.metrics = None
+        self._last_commit_walltime = 0.0
 
     # ---------------------------------------------------------------------
     # service lifecycle
@@ -710,6 +713,7 @@ class ConsensusState(BaseService):
             self.state, block_id, block
         )
         self.state = new_state
+        self._update_metrics(block)
         self._advance_to_height(new_state)
 
     def _apply_commit_block(self, block: Block, commit: Commit) -> None:
@@ -757,7 +761,29 @@ class ConsensusState(BaseService):
             self.state, commit.block_id, block, validate=False
         )
         self.state = new_state
+        self._update_metrics(block)
         self._advance_to_height(new_state)
+
+    def _update_metrics(self, block: Optional[Block]) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        import time as _t
+
+        now = _t.monotonic()
+        if self._last_commit_walltime:
+            m.block_interval.observe(now - self._last_commit_walltime)
+        self._last_commit_walltime = now
+        m.height.set(self.state.last_block_height)
+        m.rounds.set(self.round)
+        m.validators.set(len(self.state.validators))
+        if block is not None:
+            n_txs = len(block.data.txs)
+            m.num_txs.set(n_txs)
+            m.total_txs.inc(n_txs)
+            # tx payload bytes — avoids re-serializing the whole block in
+            # the commit hot path just for a gauge
+            m.block_size.set(sum(len(t) for t in block.data.txs))
 
     def _advance_to_height(self, new_state: State) -> None:
         """updateToState (state.go:2005) + scheduleRound0."""

@@ -1,0 +1,214 @@
+"""Prometheus-compatible metrics: counters, gauges, histograms.
+
+Reference: the metricsgen-generated per-package metrics structs
+(consensus/metrics.go:24-91, blocksync/metrics.go, p2p, mempool, state)
+exported via the prometheus server (node/node.go:846). This module is
+the registry + text-exposition core; per-subsystem metric sets live
+next to their components and the node serves /metrics over HTTP.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, typ: str):
+        self.name = name
+        self.help = help_
+        self.type = typ
+        self._values: Dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **labels) -> "_Bound":
+        return _Bound(self, tuple(sorted(labels.items())))
+
+    def _add(self, key: tuple, v: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + v
+
+    def _set(self, key: tuple, v: float) -> None:
+        with self._lock:
+            self._values[key] = v
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return out
+
+
+class _Bound:
+    def __init__(self, metric: Metric, key: tuple):
+        self.metric = metric
+        self.key = key
+
+    def inc(self, v: float = 1.0) -> None:
+        self.metric._add(self.key, v)
+
+    def set(self, v: float) -> None:
+        self.metric._set(self.key, v)
+
+    def observe(self, v: float) -> None:  # histogram-backed
+        self.metric._observe(self.key, v)  # type: ignore[attr-defined]
+
+
+class Counter(Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "counter")
+
+    def inc(self, v: float = 1.0, **labels) -> None:
+        self._add(tuple(sorted(labels.items())), v)
+
+
+class Gauge(Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "gauge")
+
+    def set(self, v: float, **labels) -> None:
+        self._set(tuple(sorted(labels.items())), v)
+
+    def inc(self, v: float = 1.0, **labels) -> None:
+        self._add(tuple(sorted(labels.items())), v)
+
+
+class Histogram(Metric):
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
+
+    def __init__(self, name, help_="", buckets=None):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        self._observe(tuple(sorted(labels.items())), v)
+
+    def _observe(self, key: tuple, v: float) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1)
+            )
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + v
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for key, counts in items:
+            cum = 0
+            for i, ub in enumerate(self.buckets):
+                cum += counts[i]
+                lk = key + (("le", f"{ub:g}"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            cum += counts[-1]
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} "
+                       f"{sums.get(key, 0.0):g}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "cometbft"):
+        self.namespace = namespace
+        self._metrics: List[Metric] = []
+        self._lock = threading.Lock()
+
+    def _full(self, subsystem: str, name: str) -> str:
+        return f"{self.namespace}_{subsystem}_{name}"
+
+    def counter(self, subsystem, name, help_="") -> Counter:
+        m = Counter(self._full(subsystem, name), help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, subsystem, name, help_="") -> Gauge:
+        m = Gauge(self._full(subsystem, name), help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, subsystem, name, help_="", buckets=None) -> Histogram:
+        m = Histogram(self._full(subsystem, name), help_, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class NodeMetrics:
+    """The metric set the node wires into its components — the union of
+    the reference's consensus/p2p/mempool/blocksync metricsgen structs
+    (consensus/metrics.go:24-91 etc.), prometheus-text compatible names."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = self.registry = registry or Registry()
+        # consensus
+        self.height = r.gauge("consensus", "height",
+                              "Height of the chain")
+        self.rounds = r.gauge("consensus", "rounds",
+                              "Round of the current height")
+        self.validators = r.gauge("consensus", "validators",
+                                  "Number of validators")
+        self.block_interval = r.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30),
+        )
+        self.num_txs = r.gauge("consensus", "num_txs",
+                               "Number of transactions in the latest block")
+        self.total_txs = r.counter("consensus", "total_txs",
+                                   "Total transactions committed")
+        self.block_size = r.gauge("consensus", "block_size_bytes",
+                                  "Size of the latest block")
+        # device verifier (TPU-native addition)
+        self.verify_batches = r.counter(
+            "crypto", "verify_batches_total",
+            "Device batch-verification dispatches")
+        self.verify_sigs = r.counter(
+            "crypto", "verify_sigs_total",
+            "Signatures verified on device")
+        self.verify_seconds = r.histogram(
+            "crypto", "verify_seconds",
+            "Device batch verification wall time",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1),
+        )
+        # mempool
+        self.mempool_size = r.gauge("mempool", "size",
+                                    "Pending transactions")
+        # p2p
+        self.peers = r.gauge("p2p", "peers", "Connected peers")
+        # blocksync
+        self.blocksync_syncing = r.gauge("blocksync", "syncing",
+                                         "1 while block-syncing")
+
+    def expose_text(self) -> str:
+        return self.registry.expose_text()

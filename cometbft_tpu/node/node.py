@@ -36,6 +36,7 @@ class Node(BaseService):
         p2p: bool = False,
         node_key=None,
         blocksync: bool = False,
+        pex: bool = False,
         statesync_light_client=None,
         statesync_discovery: float = 45.0,
     ):
@@ -94,9 +95,33 @@ class Node(BaseService):
         )
         self.evidence_pool.height = state.last_block_height
         self.evidence_pool.time_s = state.last_block_time.seconds
+        from cometbft_tpu.libs.metrics import NodeMetrics
         from cometbft_tpu.types.event_bus import EventBus
 
+        self.metrics = NodeMetrics()
         self.event_bus = EventBus()
+        # indexers + pruner (node/node.go:311-316 createAndStartIndexer,
+        # state/pruner.go)
+        from cometbft_tpu.state.indexer import (
+            BlockIndexer,
+            IndexerService,
+            TxIndexer,
+        )
+        from cometbft_tpu.state.pruner import Pruner
+
+        self.tx_indexer = TxIndexer(db("tx_index.db"))
+        self.block_indexer = BlockIndexer(db("block_index.db"))
+        self.indexer_service = IndexerService(
+            self.event_bus, self.tx_indexer, self.block_indexer
+        )
+        self.pruner = Pruner(
+            self.block_store, self.state_store, self.tx_indexer,
+            self.block_indexer,
+            evidence_safe_height=lambda: (
+                self.block_store.height()
+                - self.evidence_pool.max_age_blocks
+            ),
+        )
         self.block_exec = BlockExecutor(
             app, self.state_store, batch_fn=batch_fn, mempool=self.mempool,
             evidence_pool=self.evidence_pool, event_bus=self.event_bus,
@@ -111,6 +136,8 @@ class Node(BaseService):
             timeouts=timeouts,
         )
         self.consensus.evidence_pool = self.evidence_pool
+        self.consensus.metrics = self.metrics
+        self.block_exec.on_retain_height = self.pruner.set_retain_height
 
         # optional real p2p stack (node/node.go:443-447 createTransport/
         # createSwitch); when absent, `broadcast` (in-memory hub) rules
@@ -180,6 +207,17 @@ class Node(BaseService):
             )
             self.switch.add_reactor(self.statesync_reactor)
 
+            # PEX + address book (node/node.go:462-481)
+            self.pex_reactor = None
+            if pex:
+                from cometbft_tpu.p2p.pex import AddrBook, PEXReactor
+
+                self.addr_book = AddrBook(
+                    os.path.join(home, "addrbook.json") if home else None
+                )
+                self.pex_reactor = PEXReactor(self.addr_book)
+                self.switch.add_reactor(self.pex_reactor)
+
     def listen(self, host: str = "127.0.0.1", port: int = 0):
         """Start the p2p listener; returns our NetAddress."""
         return self.switch.listen(host, port)
@@ -197,6 +235,7 @@ class Node(BaseService):
         self.switch.dial_peer(addr, persistent=persistent)
 
     def on_start(self) -> None:
+        self.pruner.start()
         if self.switch is not None:
             self.switch.start()
         if getattr(self, "statesync_syncer", None) is not None:
@@ -258,6 +297,9 @@ class Node(BaseService):
     def on_stop(self) -> None:
         if getattr(self, "rpc_server", None) is not None:
             self.rpc_server.stop()
+        self.indexer_service.stop()
+        if self.pruner.is_running():
+            self.pruner.stop()
         if self.consensus.is_running():
             self.consensus.stop()
         if self.blocksync_engine is not None and \
@@ -267,10 +309,14 @@ class Node(BaseService):
             self.consensus_reactor.stop_routines()
         if self.blocksync_reactor is not None:
             self.blocksync_reactor.stop_routines()
+        if getattr(self, "pex_reactor", None) is not None:
+            self.pex_reactor.stop_routines()
         if self.switch is not None:
             self.switch.stop()
         self.block_store.close()
         self.state_store.close()
+        self.tx_indexer.close()
+        self.block_indexer.close()
 
     # convenience API (rpc/core analogs; the JSON-RPC server wraps these)
     def broadcast_tx(self, tx: bytes) -> abci.ResponseCheckTx:

@@ -64,10 +64,16 @@ class HTTPClient:
         ))
 
     def broadcast_tx_commit(self, tx: bytes):
-        return self.call("broadcast_tx_commit", tx=tx.hex())
+        import base64
+
+        return self.call("broadcast_tx_commit",
+                         tx=base64.b64encode(tx).decode())
 
     def broadcast_tx_sync(self, tx: bytes):
-        return self.call("broadcast_tx_sync", tx=tx.hex())
+        import base64
+
+        return self.call("broadcast_tx_sync",
+                         tx=base64.b64encode(tx).decode())
 
     def abci_query(self, data: bytes, path: str = ""):
         return self.call("abci_query", data=data.hex(), path=path)

@@ -207,11 +207,12 @@ class Routes:
     # -- txs ----------------------------------------------------------------
 
     def _decode_tx(self, tx) -> bytes:
-        # accept base64 (reference encoding) or hex
-        try:
-            return base64.b64decode(tx, validate=True)
-        except Exception:
-            return bytes.fromhex(tx)
+        # reference rule: JSON-RPC carries tx as base64; the URI form
+        # takes 0x-prefixed hex. Guessing (try-base64-then-hex) garbles
+        # even-length hex strings, which are also valid base64.
+        if isinstance(tx, str) and tx.startswith("0x"):
+            return bytes.fromhex(tx[2:])
+        return base64.b64decode(tx)
 
     def broadcast_tx_sync(self, tx):
         raw = self._decode_tx(tx)
@@ -233,9 +234,9 @@ class Routes:
         (rpc/core/mempool.go BroadcastTxCommit)."""
         raw = self._decode_tx(tx)
         txhash = hashlib.sha256(raw).hexdigest().upper()
+        subscriber = f"btc-{txhash}-{time.time()}"
         sub = self.node.event_bus.subscribe(
-            f"btc-{txhash}-{time.time()}",
-            f"{TX_HASH_KEY}='{txhash}'",
+            subscriber, f"{TX_HASH_KEY}='{txhash}'"
         )
         try:
             check = self.node.broadcast_tx(raw)
@@ -254,9 +255,57 @@ class Routes:
                 "height": data["height"],
             }
         finally:
-            self.node.event_bus.pubsub.unsubscribe_all(
-                f"btc-{txhash}-{time.time()}"
-            )
+            self.node.event_bus.pubsub.unsubscribe_all(subscriber)
+
+    def tx(self, hash):
+        """rpc/core/tx.go Tx: look up a committed tx by hash."""
+        item = self.node.tx_indexer.get(bytes.fromhex(hash))
+        if item is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        return {
+            "hash": item["hash"].hex().upper(),
+            "height": item["height"],
+            "index": item["index"],
+            "tx": base64.b64encode(item["tx"]).decode(),
+            "tx_result": {"code": item["code"],
+                          "data": base64.b64encode(item["data"]).decode()
+                          if item["data"] else "",
+                          "log": item["log"]},
+        }
+
+    def tx_search(self, query, limit=None):
+        """rpc/core/tx.go TxSearch over the event index."""
+        items = self.node.tx_indexer.search(
+            query, int(limit) if limit else 100
+        )
+        return {
+            "total_count": len(items),
+            "txs": [
+                {
+                    "hash": it["hash"].hex().upper(),
+                    "height": it["height"],
+                    "index": it["index"],
+                    "tx": base64.b64encode(it["tx"]).decode(),
+                    "tx_result": {"code": it["code"], "log": it["log"]},
+                }
+                for it in items
+            ],
+        }
+
+    def block_search(self, query, limit=None):
+        """rpc/core/blocks.go BlockSearch over the block-event index."""
+        heights = self.node.block_indexer.search(
+            query, int(limit) if limit else 100
+        )
+        blocks = []
+        for h in heights:
+            blk = self.node.block_store.load_block(h)
+            if blk is not None:
+                blocks.append({
+                    "block_id": serde.bid_to_j(blk.block_id()),
+                    "block": json.loads(serde.block_to_json(blk)),
+                })
+        return {"total_count": len(blocks), "blocks": blocks}
 
     def unconfirmed_txs(self, limit=None):
         txs = self.node.mempool.reap(-1)
@@ -273,7 +322,8 @@ _ROUTES = [
     "health", "status", "net_info", "genesis", "block", "block_by_hash",
     "blockchain", "commit", "validators", "abci_info", "abci_query",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
-    "unconfirmed_txs", "num_unconfirmed_txs",
+    "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
+    "block_search",
 ]
 
 
@@ -354,6 +404,17 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         if url.path == "/websocket":
             self._websocket()
+            return
+        if url.path == "/metrics":
+            # prometheus text exposition (node/node.go:846 analog)
+            m = getattr(self.routes.node, "metrics", None)
+            body = (m.expose_text() if m else "").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         method = url.path.strip("/")
         params = dict(parse_qsl(url.query))
