@@ -1,0 +1,225 @@
+"""Tx + block indexers with event-query search.
+
+Reference: state/txindex/kv/kv.go (tx results by hash, searchable by
+composite event keys), state/indexer/block/kv (block events by height),
+and the IndexerService consuming the event bus
+(state/txindex/indexer_service.go). sqlite plays the role of the KV
+store; queries use the same AND-joined condition grammar as
+libs/pubsub.Query (tx.height=5, app.key='x', CONTAINS).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import sqlite3
+import threading
+from typing import List, Optional
+
+from cometbft_tpu.libs.pubsub import Query
+
+
+class TxIndexer:
+    """txindex/kv/kv.go analog."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._db:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS txs ("
+                "hash BLOB PRIMARY KEY, height INTEGER, tx_index INTEGER, "
+                "tx BLOB, code INTEGER, data BLOB, log TEXT)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS tx_events ("
+                "key TEXT, value TEXT, height INTEGER, hash BLOB)"
+            )
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS tx_events_kv "
+                "ON tx_events(key, value)"
+            )
+
+    def index(self, height: int, tx_index: int, tx: bytes, result,
+              events: Optional[dict] = None) -> None:
+        h = hashlib.sha256(tx).digest()
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO txs VALUES (?,?,?,?,?,?,?)",
+                (h, height, tx_index, tx, result.code, result.data,
+                 result.log),
+            )
+            self._db.execute(
+                "DELETE FROM tx_events WHERE hash=?", (h,)
+            )
+            base = {"tx.height": [str(height)],
+                    "tx.hash": [h.hex().upper()]}
+            for k, vs in {**base, **(events or {})}.items():
+                for v in vs:
+                    self._db.execute(
+                        "INSERT INTO tx_events VALUES (?,?,?,?)",
+                        (k, v, height, h),
+                    )
+
+    def get(self, tx_hash: bytes) -> Optional[dict]:
+        cur = self._db.execute(
+            "SELECT height, tx_index, tx, code, data, log FROM txs "
+            "WHERE hash=?", (tx_hash,)
+        )
+        row = cur.fetchone()
+        if not row:
+            return None
+        return {"hash": tx_hash, "height": row[0], "index": row[1],
+                "tx": row[2], "code": row[3], "data": row[4],
+                "log": row[5]}
+
+    def search(self, query: str, limit: int = 100) -> List[dict]:
+        """AND-joined event conditions -> matching txs, height order."""
+        q = Query(query)
+        hashes: Optional[set] = None
+        for c in q.conditions:
+            if c.op == "=":
+                cur = self._db.execute(
+                    "SELECT hash FROM tx_events WHERE key=? AND value=?",
+                    (c.key, c.value),
+                )
+            elif c.op == "CONTAINS":
+                cur = self._db.execute(
+                    "SELECT hash FROM tx_events WHERE key=? AND "
+                    "value LIKE ?", (c.key, f"%{c.value}%"),
+                )
+            else:  # EXISTS
+                cur = self._db.execute(
+                    "SELECT hash FROM tx_events WHERE key=?", (c.key,)
+                )
+            found = {r[0] for r in cur.fetchall()}
+            hashes = found if hashes is None else hashes & found
+        out = []
+        for h in hashes or []:
+            item = self.get(h)
+            if item:
+                out.append(item)
+        # deterministic order FIRST, then truncate — slicing the raw set
+        # would drop an arbitrary subset
+        out.sort(key=lambda d: (d["height"], d["index"]))
+        return out[:limit]
+
+    def prune(self, retain_height: int) -> int:
+        with self._lock, self._db:
+            self._db.execute(
+                "DELETE FROM tx_events WHERE height < ?", (retain_height,)
+            )
+            cur = self._db.execute(
+                "DELETE FROM txs WHERE height < ?", (retain_height,)
+            )
+            return cur.rowcount
+
+    def close(self) -> None:
+        self._db.close()
+
+
+class BlockIndexer:
+    """state/indexer/block/kv analog: block events by height."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._db:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS block_events ("
+                "key TEXT, value TEXT, height INTEGER)"
+            )
+            self._db.execute(
+                "CREATE INDEX IF NOT EXISTS block_events_kv "
+                "ON block_events(key, value)"
+            )
+
+    def index(self, height: int, events: Optional[dict] = None) -> None:
+        with self._lock, self._db:
+            base = {"block.height": [str(height)]}
+            for k, vs in {**base, **(events or {})}.items():
+                for v in vs:
+                    self._db.execute(
+                        "INSERT INTO block_events VALUES (?,?,?)",
+                        (k, v, height),
+                    )
+
+    def search(self, query: str, limit: int = 100) -> List[int]:
+        q = Query(query)
+        heights: Optional[set] = None
+        for c in q.conditions:
+            if c.op == "=":
+                cur = self._db.execute(
+                    "SELECT height FROM block_events WHERE key=? AND "
+                    "value=?", (c.key, c.value),
+                )
+            elif c.op == "CONTAINS":
+                cur = self._db.execute(
+                    "SELECT height FROM block_events WHERE key=? AND "
+                    "value LIKE ?", (c.key, f"%{c.value}%"),
+                )
+            else:
+                cur = self._db.execute(
+                    "SELECT height FROM block_events WHERE key=?",
+                    (c.key,),
+                )
+            found = {r[0] for r in cur.fetchall()}
+            heights = found if heights is None else heights & found
+        return sorted(heights or [])[:limit]
+
+    def prune(self, retain_height: int) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "DELETE FROM block_events WHERE height < ?",
+                (retain_height,),
+            )
+
+    def close(self) -> None:
+        self._db.close()
+
+
+class IndexerService:
+    """Consumes the event bus and feeds both indexers
+    (state/txindex/indexer_service.go)."""
+
+    def __init__(self, event_bus, tx_indexer: TxIndexer,
+                 block_indexer: BlockIndexer):
+        self.bus = event_bus
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self._sub_tx = event_bus.subscribe(
+            "indexer", "tm.event='Tx'", capacity=1000
+        )
+        self._sub_blk = event_bus.subscribe(
+            "indexer", "tm.event='NewBlock'", capacity=100
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="indexer"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        counters = {}
+        while not self._stop.is_set():
+            msg = self._sub_tx.next(timeout=0.1)
+            while msg is not None:
+                d = msg.data
+                h = d["height"]
+                idx = counters.get(h, 0)
+                counters[h] = idx + 1
+                self.tx_indexer.index(h, idx, d["tx"], d["result"])
+                msg = self._sub_tx.next(timeout=0)
+            msg = self._sub_blk.next(timeout=0)
+            while msg is not None:
+                blk = msg.data["block"]
+                self.block_indexer.index(
+                    blk.header.height,
+                    {"block.proposer":
+                        [blk.header.proposer_address.hex().upper()]},
+                )
+                msg = self._sub_blk.next(timeout=0)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.bus.unsubscribe_all("indexer")

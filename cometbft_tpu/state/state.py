@@ -183,5 +183,13 @@ class StateStore:
         row = cur.fetchone()
         return _valset_from_j(json.loads(row[0])) if row else None
 
+    def prune_validators(self, retain_height: int) -> None:
+        """Drop validator-set history below retain_height (the pruner's
+        state-store arm; state/store.go PruneStates)."""
+        with self._lock, self._db:
+            self._db.execute(
+                "DELETE FROM validators WHERE height < ?", (retain_height,)
+            )
+
     def close(self) -> None:
         self._db.close()
