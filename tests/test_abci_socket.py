@@ -1,0 +1,78 @@
+"""ABCI socket server/client: out-of-process application boundary.
+
+Reference: abci/server/socket_server.go + abci/client/socket_client.go
++ abci/tests (driving kvstore over a socket).
+"""
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.server import ABCISocketClient, ABCISocketServer
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+FAST = TimeoutParams(
+    propose=0.4, propose_delta=0.1,
+    prevote=0.2, prevote_delta=0.1,
+    precommit=0.2, precommit_delta=0.1,
+    commit=0.01,
+)
+
+
+@pytest.fixture()
+def socket_app():
+    server = ABCISocketServer(KVStoreApplication())
+    server.start()
+    client = ABCISocketClient(*server.addr)
+    try:
+        yield client
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_roundtrip_methods(socket_app):
+    app = socket_app
+    info = app.info(abci.RequestInfo())
+    assert info.last_block_height == 0
+    assert app.check_tx(abci.RequestCheckTx(tx=b"a=1")).code == 0
+    resp = app.finalize_block(abci.RequestFinalizeBlock(
+        txs=[b"a=1", b"b=2"], height=1, hash=b"", proposer_address=b"",
+        time_seconds=0,
+    ))
+    assert len(resp.tx_results) == 2 and resp.app_hash
+    app.commit()
+    q = app.query(abci.RequestQuery(data=b"a"))
+    assert q.value == b"1"
+    info2 = app.info(abci.RequestInfo())
+    assert info2.last_block_height == 1
+
+
+def test_node_runs_over_socket_app(tmp_path):
+    """A validator whose ABCI app lives behind the socket boundary
+    commits blocks and serves queries — the process-boundary analog of
+    proxy_app != kvstore (node/node.go:302)."""
+    server = ABCISocketServer(KVStoreApplication())
+    server.start()
+    client = ABCISocketClient(*server.addr)
+    priv = PrivKey.generate(b"\x05" * 32)
+    state = State.make_genesis(
+        "sock-chain", ValidatorSet([Validator(priv.pub_key(), 10)])
+    )
+    node = Node(client, state, privval=FilePV(priv),
+                home=str(tmp_path / "n0"), timeouts=FAST)
+    node.start()
+    try:
+        assert node.consensus.wait_for_height(3, timeout=60)
+        node.broadcast_tx(b"sock=yes")
+        assert node.consensus.wait_for_height(node.height() + 2,
+                                              timeout=60)
+        assert node.query(b"sock").value == b"yes"
+    finally:
+        node.stop()
+        client.close()
+        server.stop()
