@@ -1,0 +1,77 @@
+"""Remote signer: node holds no key, the signer process does.
+
+Reference: privval/signer_listener_endpoint.go + signer_client_test.go.
+"""
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval.file_pv import DoubleSignError, FilePV
+from cometbft_tpu.privval.remote import (
+    RemoteSignerError,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+FAST = TimeoutParams(
+    propose=0.4, propose_delta=0.1,
+    prevote=0.2, prevote_delta=0.1,
+    precommit=0.2, precommit_delta=0.1,
+    commit=0.01,
+)
+
+
+@pytest.fixture()
+def remote_pair():
+    priv = PrivKey.generate(b"\x0c" * 32)
+    listener = SignerListenerEndpoint()
+    signer = SignerServer(FilePV(priv), *listener.addr)
+    signer.start()
+    assert listener.wait_for_signer(10)
+    try:
+        yield priv, listener
+    finally:
+        signer.stop()
+        listener.close()
+
+
+def test_sign_and_double_sign_protection(remote_pair):
+    priv, listener = remote_pair
+    assert listener.pub_key().data == priv.pub_key().data
+    bid_a = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xaa" * 32))
+    bid_b = BlockID(b"\xbb" * 32, PartSetHeader(1, b"\xbb" * 32))
+    addr = priv.pub_key().address()
+    v1 = Vote(vote_type=canonical.PREVOTE_TYPE, height=5, round=0,
+              block_id=bid_a, timestamp=Timestamp(1, 0),
+              validator_address=addr, validator_index=0)
+    sig = listener.sign_vote("rs-chain", v1)
+    assert priv.pub_key().verify_signature(v1.sign_bytes("rs-chain"), sig)
+    # conflicting vote at the same HRS: the SIGNER refuses
+    v2 = Vote(vote_type=canonical.PREVOTE_TYPE, height=5, round=0,
+              block_id=bid_b, timestamp=Timestamp(1, 0),
+              validator_address=addr, validator_index=0)
+    with pytest.raises(RemoteSignerError) as ei:
+        listener.sign_vote("rs-chain", v2)
+    assert "DoubleSign" in str(ei.value)
+
+
+def test_validator_runs_with_remote_signer(tmp_path, remote_pair):
+    priv, listener = remote_pair
+    state = State.make_genesis(
+        "rs-chain", ValidatorSet([Validator(priv.pub_key(), 10)])
+    )
+    node = Node(KVStoreApplication(), state, privval=listener,
+                home=str(tmp_path / "n0"), timeouts=FAST)
+    node.start()
+    try:
+        assert node.consensus.wait_for_height(3, timeout=60)
+    finally:
+        node.stop()
