@@ -106,6 +106,9 @@ class Client:
         self.store = TrustedStore()
         # instrumentation for tests/benchmarks (bisection step count)
         self.verifications = 0
+        # divergence reporting hook: receives LightClientAttackEvidence
+        # (detector.go -> full-node evidence submission seam)
+        self.on_attack_evidence = None
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -129,10 +132,10 @@ class Client:
         if latest is None:
             raise LightClientError("no trusted state: call trust_light_block")
         if height <= latest.height:
-            raise LightClientError(
-                f"height {height} <= latest trusted {latest.height}; "
-                "backwards verification not required by the sync paths"
-            )
+            # backwards verification (light/client.go:734 backwards):
+            # walk DOWN from the earliest trusted header, checking each
+            # header's last_block_id hash-links to its parent
+            return self._verify_backwards(height, now)
         target = self.primary.light_block(height)
         target.validate_basic(self.chain_id)
         if self.skipping:
@@ -142,6 +145,35 @@ class Client:
         self._cross_check(target)
         self.store.save(target)
         return target
+
+    def _verify_backwards(self, height: int, now: Timestamp) -> LightBlock:
+        """light/client.go:734: headers are trusted backwards through the
+        last_block_id hash chain (no signature checks needed — each
+        header commits to its parent's hash)."""
+        anchor = None
+        for h in sorted(self.store.heights()):
+            if h >= height:
+                anchor = self.store.get(h)
+                break
+        if anchor is None:
+            raise LightClientError("no trusted header above target")
+        if header_expired(anchor.signed_header.header,
+                          self.trusting_period, now):
+            raise LightClientError("trusted anchor expired")
+        cur = anchor
+        for h in range(anchor.height - 1, height - 1, -1):
+            prev = self.primary.light_block(h)
+            prev.validate_basic(self.chain_id)
+            self.verifications += 1
+            want = cur.signed_header.header.last_block_id.hash
+            if prev.signed_header.header.hash() != want:
+                raise LightClientError(
+                    f"backwards verification failed at height {h}: header "
+                    f"hash does not match last_block_id of height {h + 1}"
+                )
+            self.store.save(prev)
+            cur = prev
+        return cur
 
     # -- verification strategies ------------------------------------------
 
@@ -204,7 +236,11 @@ class Client:
 
     def _cross_check(self, verified: LightBlock) -> None:
         """detector.go: compare the verified header against every witness;
-        a mismatching header hash is a divergence (fork) signal."""
+        a mismatching header hash is a divergence (fork) signal. The
+        conflicting block is turned into LightClientAttackEvidence
+        (detector.go -> examineConflictingHeaderAgainstTrace) carrying
+        the byzantine signer snapshot, attached to the raised error and
+        pushed through on_attack_evidence for submission to full nodes."""
         want = verified.signed_header.header.hash()
         for i, w in enumerate(self.witnesses):
             try:
@@ -212,11 +248,51 @@ class Client:
             except LightClientError:
                 continue  # unresponsive witness is skipped, not fatal
             if alt.signed_header.header.hash() != want:
-                raise DivergenceError(
+                ev = self._make_attack_evidence(verified, alt)
+                if self.on_attack_evidence is not None and ev is not None:
+                    try:
+                        self.on_attack_evidence(ev)
+                    except Exception:  # noqa: BLE001 - reporter hook
+                        pass
+                err = DivergenceError(
                     i,
                     f"witness {i} header {alt.signed_header.header.hash()!r}"
                     f" != primary {want!r} at height {verified.height}",
                 )
+                err.evidence = ev
+                raise err
+
+    def _make_attack_evidence(self, verified: LightBlock,
+                              conflicting: LightBlock):
+        """LightClientAttackEvidence from a conflicting light block
+        (types/evidence.go:193): byzantine validators are the conflicting
+        commit's signers that are also in the trusted set at that height
+        (the lunatic/equivocation overlap, evidence.go GetByzantine...)."""
+        from cometbft_tpu.types.evidence import LightClientAttackEvidence
+
+        commit = conflicting.signed_header.commit
+        if commit is None:
+            return None
+        trusted_vals = verified.validator_set
+        byz = []
+        for cs in commit.signatures:
+            if not cs.for_block():
+                continue
+            _, val = trusted_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                byz.append(cs.validator_address)
+        common = max(
+            (h for h in self.store.heights() if h < verified.height),
+            default=verified.height,
+        )
+        return LightClientAttackEvidence(
+            conflicting_header_hash=conflicting.signed_header.header.hash(),
+            conflicting_height=conflicting.height,
+            common_height=common,
+            byzantine_validators=byz,
+            total_voting_power=trusted_vals.total_voting_power(),
+            timestamp=conflicting.signed_header.header.time,
+        )
 
     # -- maintenance -------------------------------------------------------
 

@@ -58,6 +58,9 @@ class BlockExecutor:
         self.mempool = mempool
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
+        # pruner hook: called with ResponseCommit.retain_height when the
+        # app requests pruning (state/pruner.go seam)
+        self.on_retain_height = None
 
     # -- proposal ------------------------------------------------------------
 
@@ -205,7 +208,10 @@ class BlockExecutor:
                 block.evidence,
             )
         self.state_store.save(new_state)
-        self.app.commit()
+        rc = self.app.commit()
+        if rc is not None and getattr(rc, "retain_height", 0) > 0 and \
+                self.on_retain_height is not None:
+            self.on_retain_height(rc.retain_height)
         if self.mempool:
             self.mempool.update(block.header.height, block.data.txs)
         if self.event_bus is not None:
