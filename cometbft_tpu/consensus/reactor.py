@@ -19,19 +19,29 @@ node that rejoins mid-height can finalize without full blocksync.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
-from typing import List
+from typing import List, Optional
 
 from cometbft_tpu.consensus.state import ConsensusState, ProposalMsg
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.p2p.switch import Peer, Reactor
+from cometbft_tpu.types import part_set as psmod
 from cometbft_tpu.types import serde
 from cometbft_tpu.types.proposal import Proposal
 
+_log = logging.getLogger(__name__)
+
 STATE_CHANNEL = 0x20  # NewRoundStep (reactor.go StateChannel)
-DATA_CHANNEL = 0x21   # proposals + blocks + catch-up commits
+DATA_CHANNEL = 0x21   # proposals + block parts + catch-up commits
 VOTE_CHANNEL = 0x22   # votes (reactor.go VoteChannel)
+
+MAX_ORPHAN_PARTS = 128  # parts buffered before their proposal arrives
+# DoS caps on attacker-chosen values (round-3 review findings):
+MAX_ROUND_AHEAD = 16     # proposals for rounds further ahead are dropped
+                         # (proposer_for_round costs O(round x validators))
+MAX_BLOCK_PARTS = 1024   # 64 MiB of wire form; >> any sane max_bytes
 
 
 class PeerState:
@@ -55,6 +65,12 @@ class ConsensusReactor(Reactor):
         self._seen_votes = set()
         self._seen_proposals = set()
         self._peer_states = {}  # peer -> PeerState
+        # part reassembly (state.go ProposalBlockParts analog, kept
+        # reactor-side so the state machine stays whole-block):
+        # (height, round) -> {"prop": Proposal, "ps": PartSet}
+        self._builders = {}
+        # parts that arrived before their proposal: (h, r) -> [Part]
+        self._orphan_parts = {}
         self._lock = threading.Lock()
         self._catchup_interval = catchup_interval
         self._catchup_thread = None
@@ -97,7 +113,26 @@ class ConsensusReactor(Reactor):
         if kind == "vote":
             self.switch.broadcast(VOTE_CHANNEL, _vote_bytes(payload))
         elif kind == "proposal":
-            self.switch.broadcast(DATA_CHANNEL, _proposal_bytes(payload))
+            # proposal metadata first, then every part — the block never
+            # rides whole (reactor.go:569 gossipDataRoutine; parts allow
+            # blocks larger than one MConnection message and parallel
+            # relay of independent chunks)
+            pm: ProposalMsg = payload
+            ps = pm.block.make_part_set()
+            h, r = pm.proposal.height, pm.proposal.round
+            with self._lock:
+                # seed our own bookkeeping so the echo of our proposal
+                # (relayed back by a neighbor) dedupes instead of creating
+                # an empty builder and re-flooding every returning part
+                self._seen_proposals.add(
+                    (h, r, pm.proposal.signature)
+                )
+                self._builders[(h, r)] = {"prop": pm.proposal, "ps": ps}
+            self.switch.broadcast(DATA_CHANNEL, _proposal_bytes(pm))
+            for i in range(ps.total()):
+                self.switch.broadcast(
+                    DATA_CHANNEL, _part_bytes(h, r, ps.get_part(i))
+                )
 
     def _step_bytes(self) -> bytes:
         cs = self.cs
@@ -240,31 +275,132 @@ class ConsensusReactor(Reactor):
                 ps.last_commit_block = now
             self.cs.receive_commit_block(block, commit)
             return
-        pm = _proposal_from_bytes(msg)
-        key = (pm.proposal.height, pm.proposal.round,
-               pm.proposal.signature)
+        if j.get("t") == "part":
+            self._receive_part(peer, j, msg)
+            return
+        p = _proposal_from_bytes(j)
+        key = (p.height, p.round, p.signature)
         if key in self._seen_proposals:
             return
         cs = self.cs
-        p = pm.proposal
         if p.height != cs.height:
             return
+        # cheap structural checks BEFORE the O(round x validators)
+        # proposer-priority walk and signature verify — both run on
+        # attacker-chosen input
+        p.validate_basic()
+        if p.round > cs.round + MAX_ROUND_AHEAD:
+            return  # not punishable: we may genuinely lag
+        if p.block_id.part_set_header.total > MAX_BLOCK_PARTS:
+            raise _PeerMisbehavior("absurd part count in proposal")
         # verify the proposer's signature for the proposal's own round
         # before relaying (late rounds are still relayable — peers may be
         # ahead of us)
         proposer = cs.proposer_for_round(p.round)
         if proposer is None:
             return
-        p.validate_basic()
         if not p.verify(cs.state.chain_id, proposer.pub_key):
             raise _PeerMisbehavior("invalid proposal signature")
-        if pm.block.hash() != p.block_id.hash:
-            raise _PeerMisbehavior("proposal block/hash mismatch")
         self._seen_proposals.add(key)
         if len(self._seen_proposals) > 1000:
             self._seen_proposals.clear()
-        cs.receive_proposal(pm)
-        self.switch.broadcast(DATA_CHANNEL, msg)
+        orphans = []
+        with self._lock:
+            self._gc_builders(cs.height)
+            bkey = (p.height, p.round)
+            if bkey not in self._builders:
+                self._builders[bkey] = {
+                    "prop": p,
+                    "ps": psmod.PartSet.from_header(
+                        p.block_id.part_set_header
+                    ),
+                }
+                orphans = self._orphan_parts.pop(bkey, [])
+        self.switch.broadcast(DATA_CHANNEL, msg, except_peer=peer)
+        for part in orphans:
+            # buffered parts were never proof-checked and their sender is
+            # long gone: verify, and relay the ones that belong (a part
+            # that raced ahead of its proposal must still reach peers
+            # whose only path goes through us)
+            self._add_part(None, p.height, p.round, part,
+                           relay=_part_bytes(p.height, p.round, part))
+
+    def _receive_part(self, peer: Peer, j: dict, msg: bytes) -> None:
+        h, r = int(j["h"]), int(j["r"])
+        cs = self.cs
+        if h != cs.height:
+            return
+        try:
+            part = psmod.Part.from_j(j["part"])
+        except Exception as e:  # noqa: BLE001 - malformed part payload
+            raise _PeerMisbehavior(f"malformed block part: {e}") from e
+        with self._lock:
+            known = (h, r) in self._builders
+            if not known:
+                # parts can outrun their proposal via a third-party relay;
+                # buffer a bounded number until the proposal lands
+                buf = self._orphan_parts.setdefault((h, r), [])
+                if len(buf) < MAX_ORPHAN_PARTS and \
+                        not any(q.index == part.index for q in buf):
+                    buf.append(part)
+                if len(self._orphan_parts) > 8:  # rounds are few; cap rot
+                    self._orphan_parts.pop(
+                        next(iter(self._orphan_parts)), None
+                    )
+                return
+        self._add_part(peer, h, r, part, relay=msg)
+
+    def _add_part(self, peer: Optional[Peer], h: int, r: int,
+                  part, relay) -> None:
+        """Proof-check a part against the proposal's PartSetHeader, relay
+        it if fresh, deliver the proposal when the set completes.
+
+        Proof mismatch is NOT punished: under an equivocating proposer two
+        honest nodes hold builders for different proposals at the same
+        (h, r), and each would see the other's honestly-relayed parts fail
+        verification — punishing would let one byzantine proposer
+        disconnect the honest overlay from itself."""
+        with self._lock:
+            b = self._builders.get((h, r))
+        if b is None:
+            return
+        ps: psmod.PartSet = b["ps"]
+        try:
+            fresh = ps.add_part(part)
+        except psmod.PartSetError as e:
+            _log.debug("dropped block part h=%d r=%d i=%d: %s",
+                       h, r, part.index, e)
+            return
+        if not fresh:
+            return
+        if relay is not None and self.switch is not None:
+            self.switch.broadcast(DATA_CHANNEL, relay, except_peer=peer)
+        if not ps.is_complete():
+            return
+        prop: Proposal = b["prop"]
+        try:
+            block = serde.block_from_json(ps.assemble().decode())
+            ok = block.hash() == prop.block_id.hash
+        except Exception:  # noqa: BLE001 - bytes proven, decode not
+            ok = False
+        if not ok:
+            # the parts merkle-match the proposal's PartSetHeader but the
+            # content decodes badly or hashes elsewhere: the PROPOSER
+            # lied; the relaying peer proved nothing wrong. Drop the
+            # builder so a later round can proceed.
+            _log.warning("proposal h=%d r=%d: parts match header but "
+                         "block is invalid (byzantine proposer?)", h, r)
+            with self._lock:
+                self._builders.pop((h, r), None)
+            return
+        self.cs.receive_proposal(ProposalMsg(prop, block))
+
+    def _gc_builders(self, height: int) -> None:
+        """Drop reassembly state for finished heights (lock held)."""
+        for key in [k for k in self._builders if k[0] < height]:
+            del self._builders[key]
+        for key in [k for k in self._orphan_parts if k[0] < height]:
+            del self._orphan_parts[key]
 
 
 class _PeerMisbehavior(Exception):
@@ -278,6 +414,7 @@ def _vote_bytes(vote) -> bytes:
 def _proposal_bytes(pm: ProposalMsg) -> bytes:
     p = pm.proposal
     return json.dumps({
+        "t": "proposal",
         "p": {
             "height": p.height, "round": p.round,
             "pol_round": p.pol_round,
@@ -285,16 +422,19 @@ def _proposal_bytes(pm: ProposalMsg) -> bytes:
             "ts": serde.ts_to_j(p.timestamp),
             "sig": p.signature.hex(),
         },
-        "b": json.loads(serde.block_to_json(pm.block)),
     }).encode()
 
 
-def _proposal_from_bytes(msg: bytes) -> ProposalMsg:
-    j = json.loads(msg.decode())
+def _part_bytes(height: int, round_: int, part) -> bytes:
+    return json.dumps({
+        "t": "part", "h": height, "r": round_, "part": part.to_j(),
+    }).encode()
+
+
+def _proposal_from_bytes(j: dict) -> Proposal:
     p = j["p"]
-    prop = Proposal(
+    return Proposal(
         p["height"], p["round"], p["pol_round"],
         serde.bid_from_j(p["block_id"]),
         serde.ts_from_j(p["ts"]), bytes.fromhex(p["sig"]),
     )
-    return ProposalMsg(prop, serde.block_from_json(json.dumps(j["b"])))

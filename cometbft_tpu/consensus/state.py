@@ -8,10 +8,8 @@ process (:820-828); step functions enterNewRound (:1042), enterPropose
 tryAddVote/addVote (:2110,:2161); own votes via signAddVote (:2452);
 crash recovery catchupReplay (replay.go:94).
 
-Scope notes for this slice: proposals carry whole blocks (the PartSet
-gossip split arrives with the p2p layer); prevote locking uses the
-is-locked/matches-locked rule without POL-based unlocking (safe — can
-only affect liveness under byzantine proposers, never safety). Messages
+Prevote locking implements the full rule set including POL-based
+unlocking (arXiv alg. lines 22-33; see _default_do_prevote). Messages
 reach peers via a pluggable broadcast callback so the same machine runs
 single-node, multi-node-in-process (in-memory hub), or over a real
 transport.
@@ -488,17 +486,11 @@ class ConsensusState(BaseService):
         self._check_vote_quorums()
 
     def _default_do_prevote(self, height: int, round_: int) -> None:
-        """state.go:1360 defaultDoPrevote."""
-        if self.locked_block is not None:
-            # prevote the locked block (POL-based unlocking arrives with
-            # full multi-round byzantine support)
-            if self.proposal_block is not None and \
-                    self.proposal_block.hash() == self.locked_block.hash():
-                self._sign_add_vote(canonical.PREVOTE_TYPE,
-                                    self.locked_block.block_id())
-            else:
-                self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
-            return
+        """state.go:1360 defaultDoPrevote, incl. POL-based unlocking
+        (arXiv Tendermint alg. lines 22-33): a locked node prevotes a
+        DIFFERENT proposal iff the proposal carries a proof-of-lock round
+        vr with locked_round <= vr < round and +2/3 prevoted that block
+        at vr — evidence the lock is stale and the network moved on."""
         if self.proposal_block is None:
             self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
             return
@@ -509,10 +501,29 @@ class ConsensusState(BaseService):
             )
         except Exception:
             ok = False
-        self._sign_add_vote(
-            canonical.PREVOTE_TYPE,
-            self.proposal_block.block_id() if ok else BlockID(),
-        )
+        if not ok:
+            self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
+            return
+        bid = self.proposal_block.block_id()
+        # unlocked, or proposal IS the locked block: prevote it (line 23:
+        # valid(v) ∧ (lockedRound = −1 ∨ lockedValue = v))
+        if self.locked_block is None or \
+                self.proposal_block.hash() == self.locked_block.hash():
+            self._sign_add_vote(canonical.PREVOTE_TYPE, bid)
+            return
+        # locked on something else: only a proof-of-lock unlocks us
+        # (line 29: valid(v) ∧ (lockedRound ≤ vr ∨ lockedValue = v), with
+        # the 2f+1 PREVOTE(h, vr, id(v)) trigger checked in our own sets)
+        pol = self.proposal.pol_round if self.proposal is not None else -1
+        if 0 <= pol < round_ and self.locked_round <= pol:
+            maj = self.votes.prevotes(pol).two_thirds_majority()
+            if maj is not None and not maj.is_nil() \
+                    and self.proposal_block.hash() == maj.hash:
+                # the lock itself is NOT cleared here — if this block gains
+                # +2/3 prevotes this round, enterPrecommit re-locks on it
+                self._sign_add_vote(canonical.PREVOTE_TYPE, bid)
+                return
+        self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         if round_ != self.round or self.step >= STEP_PREVOTE_WAIT:

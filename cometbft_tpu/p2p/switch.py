@@ -191,10 +191,15 @@ class Switch(BaseService):
 
     # -- messaging ---------------------------------------------------------
 
-    def broadcast(self, chan_id: int, msg: bytes) -> None:
+    def broadcast(self, chan_id: int, msg: bytes,
+                  except_peer=None) -> None:
+        """Send to every peer (switch.go Broadcast); `except_peer` skips
+        the originator when relaying flood-gossiped messages."""
         with self._peers_lock:
             peers = list(self.peers.values())
         for p in peers:
+            if p is except_peer:
+                continue
             p.send(chan_id, msg)
 
     def num_peers(self) -> int:

@@ -19,7 +19,6 @@ from typing import Callable, Dict, List, Optional
 
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.state.state import State
-from cometbft_tpu.types.block_id import BlockID, PartSetHeader
 from cometbft_tpu.types.params import ConsensusParams
 
 _log = logging.getLogger(__name__)
@@ -50,7 +49,13 @@ class LightStateProvider:
             height + 2, now=self.now
         )
         hdr = lb_last.signed_header.header
-        bid = BlockID(hdr.hash(), PartSetHeader(1, hdr.hash()))
+        # the commit's BlockID carries the REAL PartSetHeader the network
+        # committed under — a synthetic psh here would fail validate_block's
+        # full-BlockID equality against every subsequent block's
+        # header.last_block_id (execution.py:139)
+        bid = lb_last.signed_header.commit.block_id
+        if bid.hash != hdr.hash():
+            raise StateSyncError("light block commit/header hash mismatch")
         return State(
             chain_id=hdr.chain_id,
             initial_height=1,

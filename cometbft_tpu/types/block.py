@@ -154,9 +154,25 @@ class Block:
     def hash(self) -> Optional[bytes]:
         return self.header.hash()
 
+    def make_part_set(self, part_size: Optional[int] = None):
+        """Split into 64KiB parts w/ proofs (types/block.go:140
+        MakePartSet); memoized — the split is a pure function of the
+        block's canonical wire form."""
+        from cometbft_tpu.types import part_set as psmod
+
+        size = part_size or psmod.BLOCK_PART_SIZE
+        cached = getattr(self, "_part_set", None)
+        if cached is None or cached[0] != size:
+            cached = (size, psmod.make_block_parts(self, size))
+            self._part_set = cached
+        return cached[1]
+
     def block_id(self, part_set_header: Optional[PartSetHeader] = None) -> BlockID:
+        """BlockID{Hash, PartSetHeader} — the psh is the real part-set
+        merkle header (consensus-critical: votes sign over it, so every
+        node must derive the identical value from the block bytes)."""
         h = self.hash()
-        psh = part_set_header or PartSetHeader(1, h or b"")
+        psh = part_set_header or self.make_part_set().header()
         return BlockID(h or b"", psh)
 
     def fill_header(self) -> None:

@@ -1,0 +1,211 @@
+"""POL-based unlocking (liveness): a node locked on block A in round 0
+prevotes a different block B in a later round iff the proposal carries a
+proof-of-lock round vr with locked_round <= vr < round AND the node has
+seen +2/3 prevotes for B at vr.
+
+Reference: consensus/state.go:1360 defaultDoPrevote (arXiv Tendermint
+alg. lines 22-33); driven single-threaded via the swappable
+decide_proposal hook + ManualTicker (state.go:122-125 test seams).
+"""
+import queue
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.state import (
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE_WAIT,
+    ConsensusState,
+    ProposalMsg,
+    VoteMsg,
+)
+from cometbft_tpu.consensus.ticker import TimeoutInfo
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import Commit
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+CHAIN = "pol-chain"
+
+
+def drain(cs):
+    """Process everything the machine queued for itself (own votes,
+    scheduled round starts) — the single-threaded receiveRoutine stand-in."""
+    while True:
+        try:
+            item = cs.internal_queue.get_nowait()
+        except queue.Empty:
+            return
+        cs._handle(item, write_wal=False)
+
+
+def peer_vote(cs, priv, vs, vote_type, round_, bid):
+    addr = priv.pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    v = Vote(vote_type=vote_type, height=cs.height, round=round_,
+             block_id=bid, timestamp=Timestamp(1_700_000_100, 0),
+             validator_address=addr, validator_index=idx)
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    cs._handle(("vote", VoteMsg(v)), write_wal=False)
+    drain(cs)
+
+
+def signed_proposal(cs, privs, vs, round_, pol_round, block):
+    proposer = cs.proposer_for_round(round_)
+    priv = next(p for p in privs
+                if p.pub_key().address() == proposer.address)
+    bid = block.block_id()
+    prop = Proposal(cs.height, round_, pol_round, bid,
+                    Timestamp(1_700_000_050, 0))
+    prop.signature = priv.sign(prop.sign_bytes(CHAIN))
+    return ProposalMsg(prop, block)
+
+
+def fire(cs, round_, step):
+    cs._handle_timeout(TimeoutInfo(cs.height, round_, step, 0))
+    drain(cs)
+
+
+def own_votes(captured, vote_type, round_):
+    return [m[1] for m in captured
+            if m[0] == "vote" and m[1].vote_type == vote_type
+            and m[1].round == round_]
+
+
+def test_pol_unlock_prevotes_new_block():
+    privs = [PrivKey.generate(bytes([i + 40]) * 32) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis(CHAIN, vs)
+    exec_ = BlockExecutor(KVStoreApplication(), StateStore(":memory:"))
+    captured = []
+    # our node is whichever validator holds privs[0]
+    cs = ConsensusState(state, exec_, BlockStore(":memory:"),
+                        privval=FilePV(privs[0]), manual_ticker=True,
+                        broadcast=captured.append)
+    cs._started = True  # drive by hand, no thread
+    cs.decide_proposal_fn = lambda h, r: None  # never self-propose
+
+    last_commit = Commit(0, 0, BlockID(), [])
+    prop0 = cs.proposer_for_round(0).address
+    block_a = exec_.create_proposal_block(1, state, last_commit, prop0,
+                                          txs=[b"a=1"])
+    block_b = exec_.create_proposal_block(1, state, last_commit, prop0,
+                                          txs=[b"b=2"])
+    assert block_a.hash() != block_b.hash()
+    others = [p for p in privs if p is not privs[0]]
+
+    # -- round 0: lock on A -------------------------------------------------
+    cs._enter_new_round(1, 0)
+    drain(cs)
+    cs._handle(("proposal", signed_proposal(cs, privs, vs, 0, -1, block_a)),
+               write_wal=False)
+    drain(cs)
+    assert own_votes(captured, canonical.PREVOTE_TYPE, 0), "no prevote"
+    for p in others[:2]:
+        peer_vote(cs, p, vs, canonical.PREVOTE_TYPE, 0,
+                  block_a.block_id())
+    assert cs.locked_round == 0
+    assert cs.locked_block.hash() == block_a.hash()
+    # round 0 fails to commit: +2/3 precommit nil -> next round
+    for p in others:
+        peer_vote(cs, p, vs, canonical.PRECOMMIT_TYPE, 0, BlockID())
+    fire(cs, 0, STEP_PRECOMMIT_WAIT)
+    assert cs.round == 1
+
+    # -- round 1: B gets +2/3 prevotes, but we see the last one late --------
+    # (so the majority never reaches enterPrecommit, which would re-lock)
+    peer_vote(cs, others[0], vs, canonical.PREVOTE_TYPE, 1,
+              block_b.block_id())
+    peer_vote(cs, others[1], vs, canonical.PREVOTE_TYPE, 1,
+              block_b.block_id())
+    # we never saw a round-1 proposal: prevote nil off the propose timeout
+    from cometbft_tpu.consensus.state import STEP_PROPOSE
+    fire(cs, 1, STEP_PROPOSE)
+    nil_pv = own_votes(captured, canonical.PREVOTE_TYPE, 1)
+    assert nil_pv and nil_pv[-1].block_id.is_nil(), \
+        "locked node must prevote nil without the proposal"
+    fire(cs, 1, STEP_PREVOTE_WAIT)  # -> precommit nil, lock kept
+    assert cs.locked_round == 0, "lock must survive a nil round"
+    # the straggler round-1 prevote lands AFTER we precommitted: now our
+    # vote sets hold a POL for B at round 1
+    peer_vote(cs, others[2], vs, canonical.PREVOTE_TYPE, 1,
+              block_b.block_id())
+    assert cs.locked_block.hash() == block_a.hash()
+    for p in others:
+        peer_vote(cs, p, vs, canonical.PRECOMMIT_TYPE, 1, BlockID())
+    fire(cs, 1, STEP_PRECOMMIT_WAIT)
+    assert cs.round == 2
+
+    # -- round 2: proposal B arrives with pol_round=1 -> unlock -------------
+    cs._handle(("proposal", signed_proposal(cs, privs, vs, 2, 1, block_b)),
+               write_wal=False)
+    drain(cs)
+    pv2 = own_votes(captured, canonical.PREVOTE_TYPE, 2)
+    assert pv2 and pv2[-1].block_id.hash == block_b.hash(), \
+        "POL at round 1 must unlock the round-0 lock"
+
+    # +2/3 prevotes for B in round 2 -> re-lock on B, precommit B
+    for p in others[:2]:
+        peer_vote(cs, p, vs, canonical.PREVOTE_TYPE, 2,
+                  block_b.block_id())
+    assert cs.locked_round == 2
+    assert cs.locked_block.hash() == block_b.hash()
+    pc2 = own_votes(captured, canonical.PRECOMMIT_TYPE, 2)
+    assert pc2 and pc2[-1].block_id.hash == block_b.hash()
+
+
+def test_no_unlock_without_pol_evidence():
+    """A proposal claiming pol_round=1 without +2/3 prevotes at round 1 in
+    our sets must NOT unlock (the 2f+1 trigger of alg. line 28)."""
+    privs = [PrivKey.generate(bytes([i + 80]) * 32) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis(CHAIN, vs)
+    exec_ = BlockExecutor(KVStoreApplication(), StateStore(":memory:"))
+    captured = []
+    cs = ConsensusState(state, exec_, BlockStore(":memory:"),
+                        privval=FilePV(privs[0]), manual_ticker=True,
+                        broadcast=captured.append)
+    cs._started = True
+    cs.decide_proposal_fn = lambda h, r: None
+
+    last_commit = Commit(0, 0, BlockID(), [])
+    prop0 = cs.proposer_for_round(0).address
+    block_a = exec_.create_proposal_block(1, state, last_commit, prop0,
+                                          txs=[b"a=1"])
+    block_b = exec_.create_proposal_block(1, state, last_commit, prop0,
+                                          txs=[b"b=2"])
+    others = [p for p in privs if p is not privs[0]]
+
+    cs._enter_new_round(1, 0)
+    drain(cs)
+    cs._handle(("proposal", signed_proposal(cs, privs, vs, 0, -1, block_a)),
+               write_wal=False)
+    drain(cs)
+    for p in others[:2]:
+        peer_vote(cs, p, vs, canonical.PREVOTE_TYPE, 0,
+                  block_a.block_id())
+    assert cs.locked_round == 0
+    for p in others:
+        peer_vote(cs, p, vs, canonical.PRECOMMIT_TYPE, 0, BlockID())
+    fire(cs, 0, STEP_PRECOMMIT_WAIT)
+    for p in others:
+        peer_vote(cs, p, vs, canonical.PRECOMMIT_TYPE, 1, BlockID())
+    from cometbft_tpu.consensus.state import STEP_PROPOSE
+    fire(cs, 1, STEP_PROPOSE)
+    fire(cs, 1, STEP_PRECOMMIT_WAIT)
+    assert cs.round == 2
+
+    # round 2: B proposed with a LYING pol_round=1 (no prevotes seen)
+    cs._handle(("proposal", signed_proposal(cs, privs, vs, 2, 1, block_b)),
+               write_wal=False)
+    drain(cs)
+    pv2 = own_votes(captured, canonical.PREVOTE_TYPE, 2)
+    assert pv2 and pv2[-1].block_id.is_nil(), \
+        "no POL evidence -> stay locked, prevote nil"
+    assert cs.locked_block.hash() == block_a.hash()
