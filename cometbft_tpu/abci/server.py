@@ -83,6 +83,9 @@ _METHODS = {
                        abci.ResponseFinalizeBlock),
     "commit": (None, abci.ResponseCommit),
     "query": (abci.RequestQuery, abci.ResponseQuery),
+    "extend_vote": (abci.RequestExtendVote, abci.ResponseExtendVote),
+    "verify_vote_extension": (abci.RequestVerifyVoteExtension,
+                              abci.ResponseVerifyVoteExtension),
 }
 
 
@@ -101,6 +104,33 @@ def _rebuild(cls, doc):
             validators=[abci.ValidatorUpdate(**u)
                         for u in doc.get("validators", [])],
             app_hash=doc.get("app_hash", b""),
+        )
+    if cls is abci.RequestPrepareProposal:
+        llc = doc.get("local_last_commit")
+        return abci.RequestPrepareProposal(
+            max_tx_bytes=doc.get("max_tx_bytes", 0),
+            txs=doc.get("txs", []),
+            height=doc.get("height", 0),
+            proposer_address=doc.get("proposer_address", b""),
+            local_last_commit=(abci.ExtendedCommitInfo(
+                round=llc["round"],
+                votes=[abci.ExtendedVoteInfo(**v) for v in llc["votes"]],
+            ) if llc else None),
+        )
+    if cls is abci.RequestFinalizeBlock:
+        dlc = doc.get("decided_last_commit")
+        return abci.RequestFinalizeBlock(
+            txs=doc.get("txs", []),
+            hash=doc.get("hash", b""),
+            height=doc.get("height", 0),
+            proposer_address=doc.get("proposer_address", b""),
+            time_seconds=doc.get("time_seconds", 0),
+            decided_last_commit=(abci.CommitInfo(
+                round=dlc["round"],
+                votes=[abci.VoteInfo(**v) for v in dlc["votes"]],
+            ) if dlc else None),
+            misbehavior=[abci.Misbehavior(**m)
+                         for m in doc.get("misbehavior", [])],
         )
     if cls is abci.RequestInitChain:
         return abci.RequestInitChain(
@@ -234,3 +264,9 @@ class ABCISocketClient(abci.Application):
 
     def query(self, req):
         return self._call("query", req)
+
+    def extend_vote(self, req):
+        return self._call("extend_vote", req)
+
+    def verify_vote_extension(self, req):
+        return self._call("verify_vote_extension", req)

@@ -81,11 +81,59 @@ class ResponseCheckTx:
 
 
 @dataclass
+class VoteInfo:
+    """abci.VoteInfo: one LastCommit entry for the app's incentive
+    logic (execution.go:443 buildLastCommitInfo)."""
+
+    validator_address: bytes = b""
+    power: int = 0
+    block_id_flag: int = 0  # types/block.go BlockIDFlag values
+
+
+@dataclass
+class CommitInfo:
+    round: int = 0
+    votes: List[VoteInfo] = field(default_factory=list)
+
+
+@dataclass
+class ExtendedVoteInfo:
+    """abci.ExtendedVoteInfo: VoteInfo + the validator's vote extension
+    (execution.go:472 buildExtendedCommitInfo)."""
+
+    validator_address: bytes = b""
+    power: int = 0
+    block_id_flag: int = 0
+    vote_extension: bytes = b""
+    extension_signature: bytes = b""
+
+
+@dataclass
+class ExtendedCommitInfo:
+    round: int = 0
+    votes: List[ExtendedVoteInfo] = field(default_factory=list)
+
+
+@dataclass
+class Misbehavior:
+    """abci.Misbehavior (evidence reported to the app in FinalizeBlock)."""
+
+    type: str = "duplicate_vote"  # or "light_client_attack"
+    validator_address: bytes = b""
+    height: int = 0
+    time_seconds: int = 0
+    total_voting_power: int = 0
+
+
+@dataclass
 class RequestPrepareProposal:
     max_tx_bytes: int = 0
     txs: List[bytes] = field(default_factory=list)
     height: int = 0
     proposer_address: bytes = b""
+    # extensions from the previous height's precommits, when enabled
+    # (the app may fold them into the proposed txs)
+    local_last_commit: Optional[ExtendedCommitInfo] = None
 
 
 @dataclass
@@ -117,6 +165,45 @@ class RequestFinalizeBlock:
     height: int = 0
     proposer_address: bytes = b""
     time_seconds: int = 0
+    # who signed the block's LastCommit + flags (incentive logic)
+    decided_last_commit: Optional[CommitInfo] = None
+    # evidence committed in this block (execution.go extendedCommitInfo)
+    misbehavior: List[Misbehavior] = field(default_factory=list)
+
+
+@dataclass
+class RequestExtendVote:
+    """ExtendVote (application.go, execution.go:318): the app attaches
+    arbitrary data to this validator's precommit."""
+
+    hash: bytes = b""
+    height: int = 0
+    round: int = 0
+
+
+@dataclass
+class ResponseExtendVote:
+    vote_extension: bytes = b""
+
+
+@dataclass
+class RequestVerifyVoteExtension:
+    """VerifyVoteExtension (execution.go:349): validate another
+    validator's extension before accepting its precommit."""
+
+    hash: bytes = b""
+    validator_address: bytes = b""
+    height: int = 0
+    vote_extension: bytes = b""
+
+
+VERIFY_VOTE_EXTENSION_ACCEPT = 1
+VERIFY_VOTE_EXTENSION_REJECT = 2
+
+
+@dataclass
+class ResponseVerifyVoteExtension:
+    status: int = VERIFY_VOTE_EXTENSION_ACCEPT
 
 
 @dataclass
@@ -195,12 +282,16 @@ class Application:
     def query(self, req: RequestQuery) -> ResponseQuery:
         return ResponseQuery()
 
-    # vote extensions (stubs; wired when consensus supports extensions)
-    def extend_vote(self, height: int, round_: int) -> bytes:
-        return b""
+    # vote extensions (application.go ExtendVote/VerifyVoteExtension;
+    # consensus calls these for precommits once
+    # ConsensusParams.abci.vote_extensions_enable_height is reached)
+    def extend_vote(self, req: RequestExtendVote) -> ResponseExtendVote:
+        return ResponseExtendVote()
 
-    def verify_vote_extension(self, height, round_, ext: bytes) -> bool:
-        return True
+    def verify_vote_extension(
+        self, req: RequestVerifyVoteExtension
+    ) -> ResponseVerifyVoteExtension:
+        return ResponseVerifyVoteExtension()
 
     # state-sync snapshots (abci/types/application.go:9 ListSnapshots/
     # OfferSnapshot/LoadSnapshotChunk/ApplySnapshotChunk)

@@ -16,10 +16,12 @@ from cometbft_tpu.types.vote_set import VoteSet
 
 
 class HeightVoteSet:
-    def __init__(self, chain_id: str, height: int, valset: ValidatorSet):
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet,
+                 ext_enabled: bool = False):
         self.chain_id = chain_id
         self.height = height
         self.valset = valset
+        self.ext_enabled = ext_enabled
         self._lock = threading.Lock()
         self._rounds: Dict[int, Dict[int, VoteSet]] = {}
         self.round = 0
@@ -37,6 +39,7 @@ class HeightVoteSet:
                 canonical.PRECOMMIT_TYPE: VoteSet(
                     self.chain_id, self.height, round_,
                     canonical.PRECOMMIT_TYPE, self.valset,
+                    ext_enabled=self.ext_enabled,
                 ),
             }
 

@@ -332,6 +332,10 @@ class ConsensusReactor(Reactor):
             return
         try:
             part = psmod.Part.from_j(j["part"])
+            # size/index caps BEFORE buffering: orphan parts are held
+            # un-proof-checked, so the 64KiB part cap is the only bound
+            # on attacker-controlled memory here
+            part.validate_basic()
         except Exception as e:  # noqa: BLE001 - malformed part payload
             raise _PeerMisbehavior(f"malformed block part: {e}") from e
         with self._lock:

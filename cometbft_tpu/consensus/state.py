@@ -112,8 +112,7 @@ class ConsensusState(BaseService):
         self.locked_block: Optional[Block] = None
         self.valid_round = -1
         self.valid_block: Optional[Block] = None
-        self.votes = HeightVoteSet(state.chain_id, self.height,
-                                   state.validators)
+        self.votes = self._new_height_vote_set(state, self.height)
         self.commit_round = -1
         self._triggered_precommit_wait = False
         self._thread: Optional[threading.Thread] = None
@@ -157,6 +156,13 @@ class ConsensusState(BaseService):
     def _schedule_round0(self) -> None:
         self.internal_queue.put(("start_round", self.height, 0))
 
+    @staticmethod
+    def _new_height_vote_set(state: State, height: int) -> HeightVoteSet:
+        return HeightVoteSet(
+            state.chain_id, height, state.validators,
+            ext_enabled=state.consensus_params.extensions_enabled(height),
+        )
+
     def reset_to_state(self, state: State) -> None:
         """Adopt a state produced by a sync path (blocksync/statesync)
         BEFORE starting — the SwitchToConsensus seam (reactor.go:115)."""
@@ -165,8 +171,7 @@ class ConsensusState(BaseService):
         self.height = state.last_block_height + 1
         self.round = 0
         self.step = STEP_NEW_HEIGHT
-        self.votes = HeightVoteSet(state.chain_id, self.height,
-                                   state.validators)
+        self.votes = self._new_height_vote_set(state, self.height)
         self.round_validators = state.validators
         self.commit_round = -1
 
@@ -399,10 +404,18 @@ class ConsensusState(BaseService):
         if self.valid_block is not None:
             block = self.valid_block
         else:
+            ext_commit = None
+            if height > self.state.initial_height and \
+                    self.state.consensus_params.extensions_enabled(
+                        height - 1):
+                ext_commit = self.block_store.load_extended_commit(
+                    height - 1
+                )
             block = self.block_exec.create_proposal_block(
                 height, self.state,
                 self._load_last_commit(height),
                 self.privval.pub_key().address(),
+                extended_commit=ext_commit,
             )
         bid = block.block_id()
         prop = Proposal(height, round_, self.valid_round, bid,
@@ -606,7 +619,22 @@ class ConsensusState(BaseService):
             validator_address=addr,
             validator_index=idx,
         )
-        vote.signature = self.privval.sign_vote(self.state.chain_id, vote)
+        sign_ext = (
+            vote_type == canonical.PRECOMMIT_TYPE
+            and not block_id.is_nil()
+            and self.state.consensus_params.extensions_enabled(self.height)
+        )
+        if sign_ext:
+            # app extends the precommit (execution.go:318 ExtendVote);
+            # the privval signs both the vote and the extension — the
+            # extension signature is REQUIRED even when the app returns
+            # an empty extension
+            vote.extension = self.block_exec.extend_vote(
+                self.height, self.round, block_id.hash
+            )
+        vote.signature = self.privval.sign_vote(
+            self.state.chain_id, vote, sign_extension=sign_ext
+        )
         # own votes ride the internal queue so they are WAL-logged before
         # being processed (state.go:2452 signAddVote -> sendInternalMessage)
         self.internal_queue.put(("vote", VoteMsg(vote)))
@@ -616,6 +644,30 @@ class ConsensusState(BaseService):
         """state.go:2110 tryAddVote -> addVote (:2161)."""
         if vote.height != self.height:
             return
+        # app-level extension check for peers' precommits (state.go
+        # addVote -> blockExec.VerifyVoteExtension); our own extension
+        # came from the app and skips the round trip. Signature-level
+        # verification happens inside VoteSet.add_vote.
+        if (vote.vote_type == canonical.PRECOMMIT_TYPE
+                and not vote.block_id.is_nil()
+                and self.state.consensus_params.extensions_enabled(
+                    self.height)
+                and not from_replay
+                and (self.privval is None
+                     or vote.validator_address
+                     != self.privval.pub_key().address())):
+            try:
+                ok = self.block_exec.verify_vote_extension(vote)
+            except Exception:  # noqa: BLE001 - app failure != bad vote
+                _log.exception("VerifyVoteExtension app call failed")
+                ok = False
+            if not ok:
+                _log.warning(
+                    "dropped precommit with app-rejected extension "
+                    "h=%d r=%d from %s", vote.height, vote.round,
+                    vote.validator_address.hex()[:12],
+                )
+                return
         try:
             added = self.votes.add_vote(vote, verify=True)
         except ConflictingVoteError as e:
@@ -716,8 +768,15 @@ class ConsensusState(BaseService):
     def _finalize_commit(self, height: int, block_id: BlockID,
                          block: Block) -> None:
         """state.go:1739: persist, apply through ABCI, move to next height."""
-        seen_commit = self.votes.precommits(self.commit_round).make_commit()
-        self.block_store.save_block(block, seen_commit)
+        precommits = self.votes.precommits(self.commit_round)
+        ext_commit = None
+        if self.state.consensus_params.extensions_enabled(height):
+            ext_commit = precommits.make_extended_commit()
+            seen_commit = ext_commit.to_commit()
+        else:
+            seen_commit = precommits.make_commit()
+        self.block_store.save_block(block, seen_commit,
+                                    extended_commit=ext_commit)
         if self.wal:
             self.wal.write_end_height(height)
         new_state = self.block_exec.apply_block(
@@ -807,9 +866,7 @@ class ConsensusState(BaseService):
         self.locked_block = None
         self.valid_round = -1
         self.valid_block = None
-        self.votes = HeightVoteSet(
-            new_state.chain_id, self.height, new_state.validators
-        )
+        self.votes = self._new_height_vote_set(new_state, self.height)
         self.round_validators = new_state.validators
         self.commit_round = -1
         self._triggered_precommit_wait = False

@@ -200,7 +200,10 @@ class Node(BaseService):
                 )
 
                 self.statesync_syncer = Syncer(
-                    app, LightStateProvider(statesync_light_client)
+                    app, LightStateProvider(
+                        statesync_light_client,
+                        params=state.consensus_params,
+                    )
                 )
             self.statesync_reactor = StatesyncP2PReactor(
                 app, self.statesync_syncer

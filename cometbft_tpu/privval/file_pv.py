@@ -43,6 +43,7 @@ class FilePV:
         self.step = 0
         self.sign_bytes: Optional[bytes] = None
         self.signature: Optional[bytes] = None
+        self._ext_signature: Optional[bytes] = None
         if state_path and os.path.exists(state_path):
             self._load_state()
 
@@ -92,6 +93,7 @@ class FilePV:
                 "step": self.step,
                 "sign_bytes": (self.sign_bytes or b"").hex(),
                 "signature": (self.signature or b"").hex(),
+                "ext_signature": (self._ext_signature or b"").hex(),
             }, f)
             f.flush()
             os.fsync(f.fileno())
@@ -105,14 +107,24 @@ class FilePV:
         self.step = j["step"]
         self.sign_bytes = bytes.fromhex(j["sign_bytes"]) or None
         self.signature = bytes.fromhex(j["signature"]) or None
+        self._ext_signature = bytes.fromhex(
+            j.get("ext_signature", "")
+        ) or None
 
     # -- PrivValidator interface ----------------------------------------------
 
     def pub_key(self) -> PubKey:
         return self.priv_key.pub_key()
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
-        """Sign a vote with HRS regression protection (file.go:308)."""
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> bytes:
+        """Sign a vote with HRS regression protection (file.go:308).
+
+        With `sign_extension` (precommits once extensions are enabled)
+        the extension signature is produced too — even for an EMPTY
+        extension, since the vote-extension discipline requires a
+        signature on every non-nil precommit — and set on the vote in
+        place (privval SignVote's signExtension arm)."""
         step = _VOTE_STEP[vote.vote_type]
         self._check_hrs(vote.height, vote.round, step)
         sb = vote.sign_bytes(chain_id)
@@ -123,14 +135,20 @@ class FilePV:
             vote.height, vote.round, step
         ):
             if sb == self.sign_bytes:
+                vote.extension_signature = self._ext_signature or b""
                 return self.signature
             raise DoubleSignError(
                 f"conflicting vote data at {vote.height}/{vote.round}/"
                 f"{step}"
             )
         sig = self.priv_key.sign(sb)
+        ext_sig = None
+        if sign_extension and vote.vote_type == 2:  # PRECOMMIT
+            ext_sig = self.priv_key.sign(vote.extension_sign_bytes(chain_id))
+            vote.extension_signature = ext_sig
         self.height, self.round, self.step = vote.height, vote.round, step
         self.sign_bytes, self.signature = sb, sig
+        self._ext_signature = ext_sig
         self._save_state()
         return sig
 

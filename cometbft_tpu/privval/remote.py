@@ -99,11 +99,16 @@ class SignerListenerEndpoint:
             self._cached_pub = PubKey(bytes.fromhex(r["pub"]), r["type"])
         return self._cached_pub
 
-    def sign_vote(self, chain_id: str, vote: Vote) -> bytes:
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> bytes:
         r = self._call({
             "m": "sign_vote", "chain_id": chain_id,
             "vote": serde.vote_to_j(vote),
+            "sign_extension": sign_extension,
         })
+        # the extension signature is produced signer-side and travels
+        # back alongside the vote signature
+        vote.extension_signature = bytes.fromhex(r.get("ext_sig", ""))
         return bytes.fromhex(r["sig"])
 
     def sign_proposal(self, chain_id: str, height: int, round_: int,
@@ -181,8 +186,12 @@ class SignerServer(BaseService):
             return {"pub": pub.data.hex(), "type": pub.key_type}
         if m == "sign_vote":
             vote = serde.vote_from_j(req["vote"])
-            sig = self.privval.sign_vote(req["chain_id"], vote)
-            return {"sig": sig.hex()}
+            sig = self.privval.sign_vote(
+                req["chain_id"], vote,
+                sign_extension=bool(req.get("sign_extension")),
+            )
+            return {"sig": sig.hex(),
+                    "ext_sig": vote.extension_signature.hex()}
         if m == "sign_proposal":
             sig = self.privval.sign_proposal(
                 req["chain_id"], req["height"], req["round"],

@@ -68,17 +68,40 @@ class BlockExecutor:
         self, height: int, state: State, last_commit: Optional[Commit],
         proposer_address: bytes, txs: Optional[List[bytes]] = None,
         block_time: Optional[Timestamp] = None,
+        extended_commit=None,
     ) -> Block:
         """execution.go:109 — reap txs, let the app reorder via
-        PrepareProposal, assemble the block."""
+        PrepareProposal, assemble the block. `extended_commit` (the
+        previous height's ExtendedCommit, when extensions are enabled)
+        surfaces the extensions to the app as local_last_commit
+        (execution.go:472 buildExtendedCommitInfo)."""
         if txs is None:
             txs = self.mempool.reap(state.consensus_params.block.max_bytes) \
                 if self.mempool else []
+        llc = None
+        if extended_commit is not None and state.last_validators is not None:
+            votes = []
+            for i, e in enumerate(extended_commit.extended_signatures):
+                cs = e.commit_sig
+                val = (state.last_validators.validators[i]
+                       if i < len(state.last_validators) else None)
+                votes.append(abci.ExtendedVoteInfo(
+                    validator_address=(val.address if val
+                                       else cs.validator_address),
+                    power=val.voting_power if val else 0,
+                    block_id_flag=cs.flag,
+                    vote_extension=e.extension,
+                    extension_signature=e.extension_signature,
+                ))
+            llc = abci.ExtendedCommitInfo(
+                round=extended_commit.round, votes=votes
+            )
         rpp = self.app.prepare_proposal(
             abci.RequestPrepareProposal(
                 max_tx_bytes=state.consensus_params.block.max_bytes,
                 txs=list(txs), height=height,
                 proposer_address=proposer_address,
+                local_last_commit=llc,
             )
         )
         if block_time is not None:
@@ -111,6 +134,60 @@ class BlockExecutor:
                       evidence=evs)
         block.fill_header()
         return block
+
+    def _build_last_commit_info(self, state: State, block: Block):
+        """execution.go:443 buildLastCommitInfo: who signed LastCommit,
+        with flags + power, for the app's incentive logic."""
+        lc = block.last_commit
+        if lc is None or not lc.signatures or \
+                state.last_validators is None:
+            return None
+        votes = []
+        for i, cs in enumerate(lc.signatures):
+            val = (state.last_validators.validators[i]
+                   if i < len(state.last_validators) else None)
+            votes.append(abci.VoteInfo(
+                validator_address=(val.address if val
+                                   else cs.validator_address),
+                power=val.voting_power if val else 0,
+                block_id_flag=cs.flag,
+            ))
+        return abci.CommitInfo(round=lc.round, votes=votes)
+
+    def _build_misbehavior(self, block: Block):
+        """Evidence -> abci.Misbehavior (execution.go extended info)."""
+        out = []
+        for ev in block.evidence:
+            is_dup = hasattr(ev, "vote_a")
+            addr = (ev.vote_a.validator_address if is_dup else b"")
+            out.append(abci.Misbehavior(
+                type="duplicate_vote" if is_dup else "light_client_attack",
+                validator_address=addr,
+                height=ev.height,
+                time_seconds=ev.timestamp.seconds,
+                total_voting_power=ev.total_voting_power,
+            ))
+        return out
+
+    # -- vote extensions (execution.go:318 ExtendVote, :349 Verify) ---------
+
+    def extend_vote(self, height: int, round_: int,
+                    block_hash: bytes) -> bytes:
+        resp = self.app.extend_vote(abci.RequestExtendVote(
+            hash=block_hash, height=height, round=round_,
+        ))
+        return resp.vote_extension
+
+    def verify_vote_extension(self, vote) -> bool:
+        resp = self.app.verify_vote_extension(
+            abci.RequestVerifyVoteExtension(
+                hash=vote.block_id.hash,
+                validator_address=vote.validator_address,
+                height=vote.height,
+                vote_extension=vote.extension,
+            )
+        )
+        return resp.status == abci.VERIFY_VOTE_EXTENSION_ACCEPT
 
     def process_proposal(self, block: Block, state: State) -> bool:
         """execution.go:169 — ask the app to accept/reject."""
@@ -196,6 +273,10 @@ class BlockExecutor:
                 height=block.header.height,
                 proposer_address=block.header.proposer_address,
                 time_seconds=block.header.time.seconds,
+                decided_last_commit=self._build_last_commit_info(
+                    state, block
+                ),
+                misbehavior=self._build_misbehavior(block),
             )
         )
         if len(resp.tx_results) != len(block.data.txs):

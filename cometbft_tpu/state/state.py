@@ -60,6 +60,7 @@ class State:
         app_hash: bytes = b"",
         initial_height: int = 1,
         genesis_time: Optional[Timestamp] = None,
+        params: Optional[ConsensusParams] = None,
     ) -> "State":
         """MakeGenesisState (state/state.go:355)."""
         return State(
@@ -72,7 +73,7 @@ class State:
             next_validators=validators.copy_increment_proposer_priority(1),
             last_validators=None,
             last_height_validators_changed=initial_height,
-            consensus_params=ConsensusParams(),
+            consensus_params=params or ConsensusParams(),
             app_hash=app_hash,
         )
 
@@ -138,6 +139,7 @@ class StateStore:
             "lhvc": st.last_height_validators_changed,
             "app_hash": st.app_hash.hex(),
             "last_results_hash": st.last_results_hash.hex(),
+            "params": st.consensus_params.to_j(),
         }
         with self._lock, self._db:
             self._db.execute(
@@ -169,7 +171,7 @@ class StateStore:
             next_validators=_valset_from_j(j["next_validators"]),
             last_validators=_valset_from_j(j["last_validators"]),
             last_height_validators_changed=j["lhvc"],
-            consensus_params=ConsensusParams(),
+            consensus_params=ConsensusParams.from_j(j.get("params")),
             app_hash=bytes.fromhex(j["app_hash"]),
             last_results_hash=bytes.fromhex(j["last_results_hash"]),
         )

@@ -34,9 +34,14 @@ class LightStateProvider:
     The light client verifies every header it hands out (bisection from
     a trusted root), so statesync inherits light-client security."""
 
-    def __init__(self, light_client, now=None):
+    def __init__(self, light_client, now=None, params=None):
         self.lc = light_client
         self.now = now
+        # ConsensusParams are consensus-critical (vote-extension
+        # discipline) but not reconstructible from verified headers
+        # (consensus_hash covers only block params) — the operator
+        # supplies them from the genesis doc every node holds
+        self.params = params or ConsensusParams()
 
     def state_at(self, height: int) -> State:
         """State after `height` is applied (stateprovider.go State):
@@ -66,7 +71,7 @@ class LightStateProvider:
             next_validators=lb_next.validator_set.copy(),
             last_validators=lb_last.validator_set.copy(),
             last_height_validators_changed=height + 1,
-            consensus_params=ConsensusParams(),
+            consensus_params=self.params,
             app_hash=lb_cur.signed_header.header.app_hash,
             last_results_hash=lb_cur.signed_header.header.last_results_hash,
         )

@@ -24,8 +24,15 @@ class BlockStore:
             self._db.execute(
                 "CREATE TABLE IF NOT EXISTS blocks ("
                 "height INTEGER PRIMARY KEY, hash BLOB, block TEXT, "
-                "commit_json TEXT, seen_commit TEXT)"
+                "commit_json TEXT, seen_commit TEXT, ext_commit TEXT)"
             )
+            # migrate pre-extension databases (5-column schema)
+            cols = [r[1] for r in
+                    self._db.execute("PRAGMA table_info(blocks)")]
+            if "ext_commit" not in cols:
+                self._db.execute(
+                    "ALTER TABLE blocks ADD COLUMN ext_commit TEXT"
+                )
             self._db.execute(
                 "CREATE INDEX IF NOT EXISTS blocks_hash ON blocks(hash)"
             )
@@ -40,19 +47,25 @@ class BlockStore:
         r = cur.fetchone()[0]
         return r if r is not None else 0
 
-    def save_block(self, block: Block, seen_commit: Commit) -> None:
-        """SaveBlock (store.go:401): block + its own SeenCommit; the
-        block's LastCommit rides inside the block."""
+    def save_block(self, block: Block, seen_commit: Commit,
+                   extended_commit=None) -> None:
+        """SaveBlock (store.go:401) / SaveBlockWithExtendedCommit
+        (store.go:254): block + its own SeenCommit (+ the ExtendedCommit
+        with vote extensions, when enabled); the block's LastCommit rides
+        inside the block."""
         h = block.header.height
+        ext = (serde.json.dumps(serde.extcommit_to_j(extended_commit))
+               if extended_commit is not None else None)
         with self._lock, self._db:
             self._db.execute(
-                "INSERT OR REPLACE INTO blocks VALUES (?,?,?,?,?)",
+                "INSERT OR REPLACE INTO blocks VALUES (?,?,?,?,?,?)",
                 (
                     h,
                     block.hash(),
                     serde.block_to_json(block),
                     serde.json.dumps(serde.commit_to_j(block.last_commit)),
                     serde.json.dumps(serde.commit_to_j(seen_commit)),
+                    ext,
                 ),
             )
 
@@ -102,6 +115,19 @@ class BlockStore:
         row = cur.fetchone()
         return (
             serde.commit_from_j(serde.json.loads(row[0]))
+            if row and row[0] else None
+        )
+
+    def load_extended_commit(self, height: int):
+        """LoadBlockExtendedCommit (store.go:286): the seen commit WITH
+        vote extensions, present only when extensions were enabled at
+        save time."""
+        cur = self._db.execute(
+            "SELECT ext_commit FROM blocks WHERE height=?", (height,)
+        )
+        row = cur.fetchone()
+        return (
+            serde.extcommit_from_j(serde.json.loads(row[0]))
             if row and row[0] else None
         )
 

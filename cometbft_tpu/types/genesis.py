@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from cometbft_tpu.crypto.keys import PubKey
 from cometbft_tpu.state.state import State
+from cometbft_tpu.types.params import ConsensusParams
 from cometbft_tpu.types.timestamp import Timestamp
 from cometbft_tpu.types.validator import Validator, ValidatorSet
 
@@ -36,6 +37,9 @@ class GenesisDoc:
     validators: List[GenesisValidator] = field(default_factory=list)
     app_hash: bytes = b""
     app_state: Optional[dict] = None
+    consensus_params: ConsensusParams = field(
+        default_factory=ConsensusParams
+    )
 
     def validate(self) -> None:
         """ValidateAndComplete (types/genesis.go:60)."""
@@ -63,6 +67,7 @@ class GenesisDoc:
             app_hash=self.app_hash,
             initial_height=self.initial_height,
             genesis_time=self.genesis_time,
+            params=self.consensus_params,
         )
 
     # -- file format -------------------------------------------------------
@@ -85,6 +90,7 @@ class GenesisDoc:
             ],
             "app_hash": self.app_hash.hex(),
             "app_state": self.app_state,
+            "consensus_params": self.consensus_params.to_j(),
         }, indent=2)
 
     def save_as(self, path: str) -> None:
@@ -111,6 +117,9 @@ class GenesisDoc:
             ],
             app_hash=bytes.fromhex(j.get("app_hash", "")),
             app_state=j.get("app_state"),
+            consensus_params=ConsensusParams.from_j(
+                j.get("consensus_params")
+            ),
         )
         doc.validate()
         return doc

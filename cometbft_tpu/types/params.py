@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
+from typing import Optional
 
 from cometbft_tpu.libs import protoenc as pe
 
@@ -31,10 +32,25 @@ class ValidatorParams:
 
 
 @dataclass
+class ABCIParams:
+    """params.go ABCIParams: vote extensions are REQUIRED on non-nil
+    precommits at heights >= enable_height, forbidden below; 0 means
+    never enabled."""
+
+    vote_extensions_enable_height: int = 0
+
+
+@dataclass
 class ConsensusParams:
     block: BlockParams = field(default_factory=BlockParams)
     evidence: EvidenceParams = field(default_factory=EvidenceParams)
     validator: ValidatorParams = field(default_factory=ValidatorParams)
+    abci: ABCIParams = field(default_factory=ABCIParams)
+
+    def extensions_enabled(self, height: int) -> bool:
+        """params.go VoteExtensionsEnabled."""
+        e = self.abci.vote_extensions_enable_height
+        return e > 0 and height >= e
 
     def hash(self) -> bytes:
         """SHA256 of proto HashedParams (params.go HashConsensusParams)."""
@@ -42,3 +58,36 @@ class ConsensusParams:
             2, self.block.max_gas
         )
         return hashlib.sha256(body).digest()
+
+    def to_j(self) -> dict:
+        return {
+            "block": {"max_bytes": self.block.max_bytes,
+                      "max_gas": self.block.max_gas},
+            "evidence": {
+                "max_age_num_blocks": self.evidence.max_age_num_blocks,
+                "max_age_duration_ns": self.evidence.max_age_duration_ns,
+                "max_bytes": self.evidence.max_bytes,
+            },
+            "validator": {
+                "pub_key_types": list(self.validator.pub_key_types)
+            },
+            "abci": {
+                "vote_extensions_enable_height":
+                    self.abci.vote_extensions_enable_height
+            },
+        }
+
+    @staticmethod
+    def from_j(j: Optional[dict]) -> "ConsensusParams":
+        if not j:
+            return ConsensusParams()
+        b, e = j.get("block", {}), j.get("evidence", {})
+        v, a = j.get("validator", {}), j.get("abci", {})
+        return ConsensusParams(
+            block=BlockParams(**{**BlockParams().__dict__, **b}),
+            evidence=EvidenceParams(**{**EvidenceParams().__dict__, **e}),
+            validator=ValidatorParams(
+                pub_key_types=tuple(v.get("pub_key_types", ("ed25519",)))
+            ),
+            abci=ABCIParams(**{**ABCIParams().__dict__, **a}),
+        )

@@ -77,6 +77,43 @@ def commit_from_j(j) -> Optional[Commit]:
     )
 
 
+def extcommit_to_j(c):
+    """ExtendedCommit wire/storage form (store.go:254 persistence)."""
+    if c is None:
+        return None
+    return {
+        "height": c.height,
+        "round": c.round,
+        "block_id": bid_to_j(c.block_id),
+        "sigs": [
+            {
+                "cs": commit_sig_to_j(e.commit_sig),
+                "ext": e.extension.hex(),
+                "ext_sig": e.extension_signature.hex(),
+            }
+            for e in c.extended_signatures
+        ],
+    }
+
+
+def extcommit_from_j(j):
+    from cometbft_tpu.types.commit import ExtendedCommit, ExtendedCommitSig
+
+    if j is None:
+        return None
+    return ExtendedCommit(
+        j["height"], j["round"], bid_from_j(j["block_id"]),
+        [
+            ExtendedCommitSig(
+                commit_sig_from_j(e["cs"]),
+                bytes.fromhex(e["ext"]),
+                bytes.fromhex(e["ext_sig"]),
+            )
+            for e in j["sigs"]
+        ],
+    )
+
+
 def header_to_j(h: Header):
     return {
         "chain_id": h.chain_id,

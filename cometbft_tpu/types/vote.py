@@ -58,6 +58,16 @@ class Vote:
         ):
             raise VoteError("invalid signature")
 
+    def verify_extension(self, chain_id: str, pub_key) -> None:
+        """Verify the extension signature (types/vote.go:233
+        VerifyExtension). Raises VoteError."""
+        if not self.extension_signature:
+            raise VoteError("missing vote extension signature")
+        if not pub_key.verify_signature(
+            self.extension_sign_bytes(chain_id), self.extension_signature
+        ):
+            raise VoteError("invalid vote extension signature")
+
     def validate_basic(self) -> None:
         """types/vote.go:284 ValidateBasic."""
         if self.vote_type not in (

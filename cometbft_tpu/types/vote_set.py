@@ -47,7 +47,8 @@ class _BlockVotes:
 
 class VoteSet:
     def __init__(self, chain_id: str, height: int, round_: int,
-                 signed_msg_type: int, valset: ValidatorSet):
+                 signed_msg_type: int, valset: ValidatorSet,
+                 ext_enabled: bool = False):
         if height == 0:
             raise VoteSetError("cannot make VoteSet for height == 0")
         self.chain_id = chain_id
@@ -55,6 +56,9 @@ class VoteSet:
         self.round = round_
         self.signed_msg_type = signed_msg_type
         self.valset = valset
+        # vote extensions REQUIRED on non-nil precommits when enabled,
+        # forbidden otherwise (params.go VoteExtensionsEnableHeight)
+        self.ext_enabled = ext_enabled
         self._lock = threading.RLock()
         n = len(valset)
         self.votes_bit_array = BitArray(n)
@@ -106,6 +110,25 @@ class VoteSet:
                 vote.verify(self.chain_id, val.pub_key)
             except VoteError as e:
                 raise VoteSetError(f"invalid vote: {e}") from e
+
+        # extension discipline (vote_set.go:216-231 w/ extensions):
+        # required+verified on non-nil precommits when enabled; forbidden
+        # in every other case
+        is_commit_precommit = (
+            self.signed_msg_type == 2 and not vote.block_id.is_nil()
+        )
+        if self.ext_enabled and is_commit_precommit:
+            if not vote.extension_signature:
+                raise VoteSetError("vote extension signature is missing")
+            if verify:
+                try:
+                    vote.verify_extension(self.chain_id, val.pub_key)
+                except VoteError as e:
+                    raise VoteSetError(
+                        f"invalid vote extension: {e}"
+                    ) from e
+        elif vote.extension or vote.extension_signature:
+            raise VoteSetError("unexpected vote extension")
 
         return self._add_verified(vote, val.voting_power)
 
@@ -237,3 +260,25 @@ class VoteSet:
                     flag, v.validator_address, v.timestamp, v.signature,
                 ))
             return Commit(self.height, self.round, self.maj23, sigs)
+
+    def make_extended_commit(self) -> "ExtendedCommit":
+        """MakeExtendedCommit (vote_set.go:636): the commit WITH each
+        precommit's vote extension, for PrepareProposal hand-off."""
+        from cometbft_tpu.types.commit import (
+            ExtendedCommit,
+            ExtendedCommitSig,
+        )
+
+        commit = self.make_commit()
+        with self._lock:
+            esigs = []
+            for cs, v in zip(commit.signatures, self.votes):
+                if v is None or not cs.is_commit():
+                    esigs.append(ExtendedCommitSig(cs))
+                else:
+                    esigs.append(ExtendedCommitSig(
+                        cs, v.extension, v.extension_signature
+                    ))
+            return ExtendedCommit(
+                commit.height, commit.round, commit.block_id, esigs
+            )

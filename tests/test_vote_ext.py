@@ -1,0 +1,199 @@
+"""Vote extensions end-to-end.
+
+Reference: state/execution.go:318 (ExtendVote), :349
+(VerifyVoteExtension), :472 (buildExtendedCommitInfo into
+PrepareProposal), types/block.go:714-722 (ExtendedCommitSig),
+store/store.go:254 (extended-commit persistence), params.go
+VoteExtensionsEnableHeight discipline (required >= enable height,
+forbidden below).
+"""
+import threading
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.ticker import TimeoutParams
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.node.node import LocalNetwork, Node
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.state.state import State
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.params import ABCIParams, ConsensusParams
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet, VoteSetError
+
+import pytest
+
+FAST = TimeoutParams(
+    propose=0.4, propose_delta=0.1,
+    prevote=0.2, prevote_delta=0.1,
+    precommit=0.2, precommit_delta=0.1,
+    commit=0.01,
+)
+CHAIN = "ext-chain"
+
+
+class ExtensionApp(KVStoreApplication):
+    """kvstore + deterministic vote extensions; records what
+    PrepareProposal received so the test can assert the hand-off."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen_local_last_commits = []
+        self._elock = threading.Lock()
+
+    def extend_vote(self, req: abci.RequestExtendVote):
+        return abci.ResponseExtendVote(
+            vote_extension=b"ext@%d" % req.height
+        )
+
+    def verify_vote_extension(self, req: abci.RequestVerifyVoteExtension):
+        ok = req.vote_extension == b"ext@%d" % req.height
+        return abci.ResponseVerifyVoteExtension(
+            status=abci.VERIFY_VOTE_EXTENSION_ACCEPT if ok
+            else abci.VERIFY_VOTE_EXTENSION_REJECT
+        )
+
+    def prepare_proposal(self, req: abci.RequestPrepareProposal):
+        with self._elock:
+            if req.local_last_commit is not None:
+                self.seen_local_last_commits.append(
+                    (req.height, req.local_last_commit)
+                )
+        return super().prepare_proposal(req)
+
+
+def _mk_vote(priv, vs, height, round_, bid, ext=b""):
+    addr = priv.pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    v = Vote(vote_type=canonical.PRECOMMIT_TYPE, height=height,
+             round=round_, block_id=bid,
+             timestamp=Timestamp(1_700_000_000, 0),
+             validator_address=addr, validator_index=idx,
+             extension=ext)
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    if ext:
+        v.extension_signature = priv.sign(v.extension_sign_bytes(CHAIN))
+    return v
+
+
+def _fixture(n=4):
+    privs = [PrivKey.generate(bytes([i + 21]) * 32) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    return privs, vs
+
+
+def test_voteset_requires_extension_when_enabled():
+    privs, vs = _fixture()
+    bid = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    vset = VoteSet(CHAIN, 5, 0, canonical.PRECOMMIT_TYPE, vs,
+                   ext_enabled=True)
+    # missing extension signature -> rejected
+    with pytest.raises(VoteSetError, match="extension"):
+        vset.add_vote(_mk_vote(privs[0], vs, 5, 0, bid))
+    # forged extension signature -> rejected
+    v = _mk_vote(privs[0], vs, 5, 0, bid, ext=b"data")
+    v.extension_signature = b"\x01" * 64
+    with pytest.raises(VoteSetError, match="extension"):
+        vset.add_vote(v)
+    # well-signed extension -> accepted
+    assert vset.add_vote(_mk_vote(privs[0], vs, 5, 0, bid, ext=b"data"))
+    # nil precommits need no extension even when enabled
+    assert vset.add_vote(_mk_vote(privs[1], vs, 5, 0, BlockID()))
+
+
+def test_voteset_forbids_extension_when_disabled():
+    privs, vs = _fixture()
+    bid = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    vset = VoteSet(CHAIN, 5, 0, canonical.PRECOMMIT_TYPE, vs,
+                   ext_enabled=False)
+    with pytest.raises(VoteSetError, match="unexpected"):
+        vset.add_vote(_mk_vote(privs[0], vs, 5, 0, bid, ext=b"data"))
+
+
+def test_empty_extensions_still_progress(tmp_path):
+    """An app that returns EMPTY extensions (the base Application
+    default) must not halt the chain: the extension signature is
+    required and produced even over empty bytes."""
+    privs, vs = _fixture(2)
+    params = ConsensusParams(
+        abci=ABCIParams(vote_extensions_enable_height=1)
+    )
+    state = State.make_genesis(CHAIN, vs, params=params)
+    net = LocalNetwork()
+    nodes = []
+    for i, priv in enumerate(privs):
+        node = Node(KVStoreApplication(), state.copy(),
+                    privval=FilePV(priv), home=str(tmp_path / f"e{i}"),
+                    broadcast=net.broadcaster(i), timeouts=FAST)
+        net.add(node)
+        nodes.append(node)
+    for n in nodes:
+        n.start()
+    try:
+        for n in nodes:
+            assert n.consensus.wait_for_height(3, timeout=60), \
+                f"stuck at {n.height()}"
+        ec = nodes[0].block_store.load_extended_commit(2)
+        assert ec is not None
+        assert all(e.extension == b"" and e.extension_signature
+                   for e in ec.extended_signatures
+                   if e.commit_sig.is_commit())
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_extensions_flow_through_network(tmp_path):
+    """4 validators with extensions enabled from height 1: extended
+    commits are persisted with every signer's extension, and the next
+    proposer hands them to PrepareProposal as local_last_commit."""
+    privs, vs = _fixture()
+    params = ConsensusParams(
+        abci=ABCIParams(vote_extensions_enable_height=1)
+    )
+    state = State.make_genesis(CHAIN, vs, params=params)
+    net = LocalNetwork()
+    nodes, apps = [], []
+    for i, priv in enumerate(privs):
+        app = ExtensionApp()
+        node = Node(app, state.copy(), privval=FilePV(priv),
+                    home=str(tmp_path / f"n{i}"),
+                    broadcast=net.broadcaster(i), timeouts=FAST)
+        net.add(node)
+        nodes.append(node)
+        apps.append(app)
+    for n in nodes:
+        n.start()
+    try:
+        for n in nodes:
+            assert n.consensus.wait_for_height(4, timeout=60), \
+                f"stuck at {n.height()}"
+        # extended commit persisted w/ verified extensions per signer
+        ec = nodes[0].block_store.load_extended_commit(2)
+        assert ec is not None
+        n_with_ext = 0
+        for i, e in enumerate(ec.extended_signatures):
+            if not e.commit_sig.is_commit():
+                continue
+            assert e.extension == b"ext@2"
+            v = ec.get_extended_vote(i)
+            _, val = vs.get_by_address(e.commit_sig.validator_address)
+            v.verify_extension(CHAIN, val.pub_key)  # raises on forgery
+            n_with_ext += 1
+        assert n_with_ext >= 3  # +2/3 of 4 validators
+    finally:
+        for n in nodes:
+            n.stop()
+
+    # some proposer saw the previous height's extensions in
+    # PrepareProposal.local_last_commit
+    seen = [(h, llc) for app in apps
+            for (h, llc) in app.seen_local_last_commits]
+    assert seen, "no proposer ever received local_last_commit"
+    h, llc = seen[0]
+    exts = [v.vote_extension for v in llc.votes
+            if v.block_id_flag == 2 and v.vote_extension]
+    assert exts and all(x == b"ext@%d" % (h - 1) for x in exts)
