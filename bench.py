@@ -169,8 +169,8 @@ def cfg1_live_node():
             store = nodes[0].block_store
             block = store.load_block(3)
             commit = store.load_block_commit(3)  # block 4's LastCommit
-            bid = BlockID(block.hash(),
-                          PartSetHeader(1, block.hash()))
+            # the real part-set BlockID the network committed under
+            bid = block.block_id()
         finally:
             for n in nodes:
                 n.stop()

@@ -88,6 +88,16 @@ _METHODS = {
                               abci.ResponseVerifyVoteExtension),
 }
 
+# plain-argument methods (the snapshot family takes positional args,
+# not request dataclasses): name -> (args_rebuild, resp_rebuild)
+_ARG_METHODS = {
+    "list_snapshots": (None,
+                       lambda r: [abci.Snapshot(**s) for s in r]),
+    "offer_snapshot": (lambda a: [abci.Snapshot(**a[0])], None),
+    "load_snapshot_chunk": (None, None),
+    "apply_snapshot_chunk": (None, None),
+}
+
 
 def _rebuild(cls, doc):
     """Dataclass from decoded dict, recursing into typed list fields."""
@@ -192,17 +202,22 @@ class ABCISocketServer(BaseService):
                     return
                 method = req.get("m")
                 spec = _METHODS.get(method)
-                if spec is None:
+                argspec = _ARG_METHODS.get(method)
+                if spec is None and argspec is None:
                     _send_msg(conn, {"err": f"unknown method {method!r}"})
                     continue
-                req_cls, _ = spec
                 try:
                     with self._app_lock:
                         fn = getattr(self.app, method)
-                        if req_cls is None:
+                        if argspec is not None:
+                            args = _dec(req.get("a", []))
+                            if argspec[0] is not None:
+                                args = argspec[0](args)
+                            resp = fn(*args)
+                        elif spec[0] is None:
                             resp = fn()
                         else:
-                            resp = fn(_rebuild(req_cls, _dec(req["q"])))
+                            resp = fn(_rebuild(spec[0], _dec(req["q"])))
                     _send_msg(conn, {"r": _enc(resp)})
                 except Exception as e:  # noqa: BLE001 - surface app error
                     _send_msg(conn, {"err": repr(e)})
@@ -270,3 +285,29 @@ class ABCISocketClient(abci.Application):
 
     def verify_vote_extension(self, req):
         return self._call("verify_vote_extension", req)
+
+    # snapshot family: positional-arg wire form (_ARG_METHODS)
+    def _call_args(self, method: str, *args):
+        resp_fix = _ARG_METHODS[method][1]
+        with self._lock:
+            _send_msg(self._conn, {"m": method, "a": _enc(list(args))})
+            resp = _recv_msg(self._conn)
+        if resp is None:
+            raise ConnectionError("abci socket closed")
+        if "err" in resp:
+            raise RuntimeError(f"abci app error: {resp['err']}")
+        r = _dec(resp["r"])
+        return resp_fix(r) if resp_fix else r
+
+    def list_snapshots(self):
+        return self._call_args("list_snapshots")
+
+    def offer_snapshot(self, snapshot):
+        return self._call_args("offer_snapshot", snapshot)
+
+    def load_snapshot_chunk(self, height, fmt, chunk):
+        return self._call_args("load_snapshot_chunk", height, fmt, chunk)
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        return self._call_args("apply_snapshot_chunk", index, chunk,
+                               sender)

@@ -491,7 +491,7 @@ class ConsensusState(BaseService):
 
     def _enter_prevote(self, height: int, round_: int) -> None:
         """state.go:1311."""
-        if self.step >= STEP_PREVOTE:
+        if height != self.height or self.step >= STEP_PREVOTE:
             return
         self.step = STEP_PREVOTE
         self._notify_step()
@@ -539,7 +539,8 @@ class ConsensusState(BaseService):
         self._sign_add_vote(canonical.PREVOTE_TYPE, BlockID())
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
-        if round_ != self.round or self.step >= STEP_PREVOTE_WAIT:
+        if height != self.height or round_ != self.round \
+                or self.step >= STEP_PREVOTE_WAIT:
             return
         self.step = STEP_PREVOTE_WAIT
         self.ticker.schedule(TimeoutInfo(
@@ -549,10 +550,10 @@ class ConsensusState(BaseService):
 
     def _enter_precommit(self, height: int, round_: int) -> None:
         """state.go:1513."""
-        # round guard (state.go:1515): a stale round's nil-precommit
-        # majority must not make us sign a precommit in the current round
-        # off the old round's prevotes
-        if round_ != self.round or self.step >= STEP_PRECOMMIT:
+        # height+round guard (state.go:1515): a stale height/round
+        # majority must not make us sign a precommit in the current one
+        if height != self.height or round_ != self.round \
+                or self.step >= STEP_PRECOMMIT:
             return
         self.step = STEP_PRECOMMIT
         self._notify_step()
@@ -590,7 +591,8 @@ class ConsensusState(BaseService):
         # stretching stalled rounds indefinitely. The step is NOT advanced
         # — precommit-wait can be triggered from any step once +2/3-any
         # precommits exist for the round.
-        if round_ != self.round or self._triggered_precommit_wait:
+        if height != self.height or round_ != self.round \
+                or self._triggered_precommit_wait:
             return
         self._triggered_precommit_wait = True
         self.ticker.schedule(TimeoutInfo(
@@ -656,6 +658,21 @@ class ConsensusState(BaseService):
                 and (self.privval is None
                      or vote.validator_address
                      != self.privval.pub_key().address())):
+            # authenticate BEFORE the app round trip: the ABCI call may
+            # cross a process boundary, and the app must never see
+            # extensions from spoofed validators (the p2p reactor has
+            # already sig-checked reactor-delivered votes; this covers
+            # every other intake path)
+            val = self.state.validators.get_by_index(vote.validator_index)
+            if val is None or val.address != vote.validator_address:
+                return
+            try:
+                vote.verify(self.state.chain_id, val.pub_key)
+                vote.verify_extension(self.state.chain_id, val.pub_key)
+            except Exception:  # noqa: BLE001 - forged: drop silently
+                _log.warning("dropped precommit w/ bad signature(s) "
+                             "before extension verify h=%d", vote.height)
+                return
             try:
                 ok = self.block_exec.verify_vote_extension(vote)
             except Exception:  # noqa: BLE001 - app failure != bad vote
@@ -710,20 +727,30 @@ class ConsensusState(BaseService):
         """Quorum-driven step transitions (state.go addVote tail), keyed on
         the VOTE's round: a quorum can complete in a round other than the
         one this node is currently in (e.g. we timed out into round r+1
-        just before the last round-r precommit arrived)."""
+        just before the last round-r precommit arrived).
+
+        Every transition is pinned to the height at ENTRY: a nested call
+        (quorum -> commit -> finalize) advances self.height under us, and
+        continuing with the new height would push the fresh height into
+        phantom steps off the old height's majorities (found by the
+        rollback-restart replay test — the machine wedged at COMMIT of
+        H+1 with H's precommit majority)."""
+        h = self.height
         if vr is None:
             vr = self.round
         prevotes = self.votes.prevotes(vr)
         if vr == self.round and \
                 self.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
             if prevotes.has_two_thirds_majority():
-                self._enter_precommit(self.height, vr)
+                self._enter_precommit(h, vr)
             elif prevotes.has_two_thirds_any():
-                self._enter_prevote_wait(self.height, vr)
+                self._enter_prevote_wait(h, vr)
         elif vr > self.round and prevotes.has_two_thirds_any():
             # round skip (state.go:2260): the network has moved on
-            self._enter_new_round(self.height, vr)
+            self._enter_new_round(h, vr)
 
+        if h != self.height:
+            return  # a nested transition finalized this height
         precommits = self.votes.precommits(vr)
         maj = precommits.two_thirds_majority()
         if maj is not None:
@@ -731,15 +758,15 @@ class ConsensusState(BaseService):
             # enterCommit/enterPrecommitWait — our own precommit must be
             # signed (and lock bookkeeping done) even when the majority
             # formed before we reached STEP_PRECOMMIT ourselves
-            self._enter_new_round(self.height, vr)  # no-op unless vr > round
-            self._enter_precommit(self.height, vr)
+            self._enter_new_round(h, vr)  # no-op unless vr > round
+            self._enter_precommit(h, vr)
             if not maj.is_nil():
-                self._enter_commit(self.height, vr)
+                self._enter_commit(h, vr)
             else:
-                self._enter_precommit_wait(self.height, vr)
+                self._enter_precommit_wait(h, vr)
         elif vr >= self.round and precommits.has_two_thirds_any():
-            self._enter_new_round(self.height, vr)
-            self._enter_precommit_wait(self.height, vr)
+            self._enter_new_round(h, vr)
+            self._enter_precommit_wait(h, vr)
 
     # ---------------------------------------------------------------------
     # step: commit / finalize
@@ -747,7 +774,7 @@ class ConsensusState(BaseService):
 
     def _enter_commit(self, height: int, round_: int) -> None:
         """state.go:1648."""
-        if self.step >= STEP_COMMIT:
+        if height != self.height or self.step >= STEP_COMMIT:
             return
         self.step = STEP_COMMIT
         self.commit_round = round_

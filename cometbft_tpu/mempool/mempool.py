@@ -23,6 +23,7 @@ class Mempool:
         self.max_txs = max_txs
         self._txs: deque = deque()
         self._tx_set = set()
+        self._tx_gas = {}  # tx -> gas_wanted from its CheckTx
         self._cache: "OrderedDict[bytes, None]" = OrderedDict()
         self._lock = threading.Lock()
 
@@ -46,6 +47,7 @@ class Mempool:
                 elif len(self._txs) < self.max_txs:
                     self._txs.append(tx)
                     self._tx_set.add(tx)
+                    self._tx_gas[tx] = resp.gas_wanted
                 else:
                     # mempool full: drop AND un-cache so a resubmission
                     # isn't silently swallowed forever (clist_mempool.go
@@ -61,17 +63,24 @@ class Mempool:
                 self._cache.pop(tx, None)
         return resp
 
-    def reap(self, max_bytes: int = -1, max_txs: int = -1) -> List[bytes]:
-        """ReapMaxBytesMaxGas (clist_mempool.go:519)."""
-        out, total = [], 0
+    def reap(self, max_bytes: int = -1, max_txs: int = -1,
+             max_gas: int = -1) -> List[bytes]:
+        """ReapMaxBytesMaxGas (clist_mempool.go:519): byte, count, and
+        gas caps; a tx whose gas_wanted would push past max_gas stops
+        the reap (same early-break as the reference)."""
+        out, total, gas = [], 0, 0
         with self._lock:
             for tx in self._txs:
                 if max_txs >= 0 and len(out) >= max_txs:
                     break
                 if max_bytes >= 0 and total + len(tx) > max_bytes:
                     break
+                g = self._tx_gas.get(tx, 0)
+                if max_gas >= 0 and gas + g > max_gas:
+                    break
                 out.append(tx)
                 total += len(tx)
+                gas += g
         return out
 
     def update(self, height: int, committed: List[bytes],
@@ -85,6 +94,8 @@ class Mempool:
             survivors = [t for t in self._txs if t not in committed_set]
             self._txs = deque(survivors)
             self._tx_set -= committed_set
+            for t in committed_set:
+                self._tx_gas.pop(t, None)
         if not recheck or not survivors:
             return
         keep = []
@@ -104,8 +115,11 @@ class Mempool:
                 for t in dropped:
                     # invalid txs leave the cache (resubmittable later)
                     self._cache.pop(t, None)
+                    self._tx_gas.pop(t, None)
 
     def flush(self) -> None:
         with self._lock:
             self._txs.clear()
             self._tx_set.clear()
+            self._tx_gas.clear()
+            self._cache.clear()

@@ -39,12 +39,25 @@ class Node(BaseService):
         pex: bool = False,
         statesync_light_client=None,
         statesync_discovery: float = 45.0,
+        app_state_bytes: bytes = b"",
     ):
         """statesync_light_client: a light.Client already trusting a root
         header; providing it turns on the statesync->blocksync->consensus
         start sequence (node/node.go:527, statesync/syncer.go:145)."""
         super().__init__("Node")
-        self.app = app
+        # four logical ABCI connections over the one app
+        # (proxy/multi_app_conn.go; node/node.go:302
+        # createAndStartProxyAppConns) — callers may also hand in a
+        # ready-made AppConns (e.g. AppConns.socket for an
+        # out-of-process app)
+        from cometbft_tpu.abci.proxy import AppConns
+
+        if isinstance(app, AppConns):
+            self.app_conns = app
+        else:
+            self.app_conns = AppConns.in_process(app)
+        app = self.app_conns.consensus
+        self.app = app  # consensus conn: handshake/replay/apply path
         self.home = home
         db = lambda name: (
             os.path.join(home, name) if home else ":memory:"
@@ -60,9 +73,38 @@ class Node(BaseService):
         state = persisted if persisted is not None else genesis_state
         if persisted is None:
             ri = self.app.init_chain(abci.RequestInitChain(
+                time_seconds=state.last_block_time.seconds,
                 chain_id=state.chain_id,
                 initial_height=state.initial_height,
+                # genesis validators + app state reach the app
+                # (abci InitChain contract; node/node.go handshake)
+                validators=[
+                    abci.ValidatorUpdate(v.pub_key.data, v.voting_power,
+                                         v.pub_key.key_type)
+                    for v in state.validators.validators
+                ],
+                app_state_bytes=app_state_bytes,
             ))
+            # the app may amend the genesis validator set in its
+            # InitChain response (abci spec); ours treats a non-empty
+            # response as authoritative replacement
+            if ri.validators:
+                from cometbft_tpu.crypto.keys import PubKey
+                from cometbft_tpu.types.validator import (
+                    Validator,
+                    ValidatorSet,
+                )
+
+                vs = ValidatorSet([
+                    Validator(PubKey(u.pub_key, u.key_type), u.power)
+                    for u in ri.validators
+                ])
+                from dataclasses import replace
+
+                state = replace(
+                    state, validators=vs,
+                    next_validators=vs.copy_increment_proposer_priority(1),
+                )
             if ri.app_hash:
                 from dataclasses import replace
 
@@ -85,7 +127,7 @@ class Node(BaseService):
                 ))
                 self.app.commit()
 
-        self.mempool = Mempool(app)
+        self.mempool = Mempool(self.app_conns.mempool)
         # evidence pool backed by the state store's validator history
         # (node/node.go:369 createEvidenceReactor)
         from cometbft_tpu.evidence.pool import EvidencePool
@@ -200,13 +242,13 @@ class Node(BaseService):
                 )
 
                 self.statesync_syncer = Syncer(
-                    app, LightStateProvider(
+                    self.app_conns.snapshot, LightStateProvider(
                         statesync_light_client,
                         params=state.consensus_params,
                     )
                 )
             self.statesync_reactor = StatesyncP2PReactor(
-                app, self.statesync_syncer
+                self.app_conns.snapshot, self.statesync_syncer
             )
             self.switch.add_reactor(self.statesync_reactor)
 
@@ -318,6 +360,10 @@ class Node(BaseService):
             self.switch.stop()
         self.block_store.close()
         self.state_store.close()
+        if self.indexer_service._thread.is_alive():
+            # join timed out: leaking the connections beats closing them
+            # under a live thread (sqlite segfaults, not raises)
+            return
         self.tx_indexer.close()
         self.block_indexer.close()
 
@@ -332,7 +378,9 @@ class Node(BaseService):
         return self.consensus.state.last_block_height
 
     def query(self, key: bytes) -> abci.ResponseQuery:
-        return self.app.query(abci.RequestQuery(data=key))
+        return self.app_conns.query.query(
+            abci.RequestQuery(data=key)
+        )
 
 
 class LocalNetwork:

@@ -162,13 +162,23 @@ def pack_batch(
     s_raw = np.zeros((padded, 32), np.uint8)
     sha512 = hashlib.sha512
     if all(lenok):
-        # fast path: single join + frombuffer per array (no per-row numpy)
-        a_raw[:n] = np.frombuffer(b"".join(pubkeys), np.uint8).reshape(n, 32)
-        sig_cat = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
+        from cometbft_tpu import native
+
+        pub_cat, sig_cat_b = b"".join(pubkeys), b"".join(sigs)
+        # fully-native pack: digest + mod-L + limb/nibble decomposition
+        # + S<L precheck in ONE call (cometbft_tpu/native hostaccel);
+        # numpy+hashlib pipeline below is the fallback and the
+        # differential reference (tests/test_native.py pack parity)
+        packed = native.ed25519_pack(pub_cat, sig_cat_b, msgs, padded)
+        if packed is not None:
+            ay, asign, ry, rsign, sdig, hdig, precheck = packed
+            return PackedBatch(n, padded, ay, asign, ry, rsign, sdig,
+                               hdig, precheck)
+        # fast numpy path: single join + frombuffer per array
+        a_raw[:n] = np.frombuffer(pub_cat, np.uint8).reshape(n, 32)
+        sig_cat = np.frombuffer(sig_cat_b, np.uint8).reshape(n, 64)
         r_raw[:n] = sig_cat[:, :32]
         s_raw[:n] = sig_cat[:, 32:]
-        # SHA-512 stays a host loop (C speed); everything downstream of
-        # the digests is vectorized
         digests = [
             sha512(sig[:32] + pk + msg).digest()
             for pk, msg, sig in zip(pubkeys, msgs, sigs)
@@ -185,8 +195,8 @@ def pack_batch(
             digests[i] = sha512(sig[:32] + pk + msg).digest()
         lenok_np = np.asarray(lenok, np.bool_)
 
-    # h = digest mod L: C-bigint per row (sub-microsecond), then one
-    # vectorized nibble split for the whole batch
+    # h = digest mod L: C-bigint per row (the native path returned
+    # above), then one vectorized nibble split for the batch
     h_bytes = np.zeros((padded, 32), np.uint8)
     if n:
         from_b, to_b = int.from_bytes, int.to_bytes

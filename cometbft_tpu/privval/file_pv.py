@@ -135,7 +135,16 @@ class FilePV:
             vote.height, vote.round, step
         ):
             if sb == self.sign_bytes:
-                vote.extension_signature = self._ext_signature or b""
+                # extensions are NOT covered by sb and may differ between
+                # retries (the app regenerates them) — re-sign the
+                # extension unconditionally; only the vote signature is
+                # double-sign-protected (file.go re-signs it too)
+                if sign_extension and vote.vote_type == 2:
+                    vote.extension_signature = self.priv_key.sign(
+                        vote.extension_sign_bytes(chain_id)
+                    )
+                else:
+                    vote.extension_signature = self._ext_signature or b""
                 return self.signature
             raise DoubleSignError(
                 f"conflicting vote data at {vote.height}/{vote.round}/"

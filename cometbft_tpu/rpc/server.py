@@ -182,7 +182,7 @@ class Routes:
     def abci_info(self):
         from cometbft_tpu.abci import types as abci
 
-        info = self.node.app.info(abci.RequestInfo())
+        info = self.node.app_conns.query.info(abci.RequestInfo())
         return {"response": {
             "data": info.data,
             "last_block_height": info.last_block_height,
@@ -192,7 +192,7 @@ class Routes:
     def abci_query(self, path=None, data=None, height=None, prove=None):
         from cometbft_tpu.abci import types as abci
 
-        resp = self.node.app.query(abci.RequestQuery(
+        resp = self.node.app_conns.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path or "",
         ))
@@ -429,8 +429,16 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception:
             self._reply_error(-32700, "parse error")
             return
-        self._call(req.get("method", ""), req.get("params") or {},
-                   req.get("id"))
+        if not isinstance(req, dict):
+            # fuzz finding: a JSON array/scalar body crashed the handler
+            # thread on req.get — JSON-RPC requires an object
+            self._reply_error(-32600, "invalid request")
+            return
+        params = req.get("params") or {}
+        if not isinstance(params, dict):
+            self._reply_error(-32600, "params must be an object")
+            return
+        self._call(req.get("method", ""), params, req.get("id"))
 
     # -- WebSocket (RFC 6455 minimal) --------------------------------------
 

@@ -76,10 +76,15 @@ class BlockExecutor:
         surfaces the extensions to the app as local_last_commit
         (execution.go:472 buildExtendedCommitInfo)."""
         if txs is None:
-            txs = self.mempool.reap(state.consensus_params.block.max_bytes) \
-                if self.mempool else []
+            txs = self.mempool.reap(
+                max_bytes=state.consensus_params.block.max_bytes,
+                max_gas=state.consensus_params.block.max_gas,
+            ) if self.mempool else []
         llc = None
         if extended_commit is not None and state.last_validators is not None:
+            # stored rows are trusted-ish but cheap to re-check: a
+            # corrupted extended commit must not reach the app
+            extended_commit.validate_basic(extensions_enabled=True)
             votes = []
             for i, e in enumerate(extended_commit.extended_signatures):
                 cs = e.commit_sig

@@ -61,6 +61,10 @@ class TxIndexer:
                     )
 
     def get(self, tx_hash: bytes) -> Optional[dict]:
+        with self._lock:
+            return self._get_locked(tx_hash)
+
+    def _get_locked(self, tx_hash: bytes) -> Optional[dict]:
         cur = self._db.execute(
             "SELECT height, tx_index, tx, code, data, log FROM txs "
             "WHERE hash=?", (tx_hash,)
@@ -73,6 +77,10 @@ class TxIndexer:
                 "log": row[5]}
 
     def search(self, query: str, limit: int = 100) -> List[dict]:
+        with self._lock:
+            return self._search_locked(query, limit)
+
+    def _search_locked(self, query: str, limit: int = 100) -> List[dict]:
         """AND-joined event conditions -> matching txs, height order."""
         q = Query(query)
         hashes: Optional[set] = None
@@ -95,7 +103,7 @@ class TxIndexer:
             hashes = found if hashes is None else hashes & found
         out = []
         for h in hashes or []:
-            item = self.get(h)
+            item = self._get_locked(h)
             if item:
                 out.append(item)
         # deterministic order FIRST, then truncate — slicing the raw set
@@ -114,7 +122,11 @@ class TxIndexer:
             return cur.rowcount
 
     def close(self) -> None:
-        self._db.close()
+        # the lock orders close after any in-flight statement — closing
+        # a sqlite connection mid-cursor segfaults CPython (found by
+        # tests/test_stress.py)
+        with self._lock:
+            self._db.close()
 
 
 class BlockIndexer:
@@ -144,6 +156,10 @@ class BlockIndexer:
                     )
 
     def search(self, query: str, limit: int = 100) -> List[int]:
+        with self._lock:
+            return self._search_locked(query, limit)
+
+    def _search_locked(self, query: str, limit: int = 100) -> List[int]:
         q = Query(query)
         heights: Optional[set] = None
         for c in q.conditions:
@@ -174,7 +190,8 @@ class BlockIndexer:
             )
 
     def close(self) -> None:
-        self._db.close()
+        with self._lock:
+            self._db.close()
 
 
 class IndexerService:

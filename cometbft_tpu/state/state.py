@@ -156,34 +156,36 @@ class StateStore:
             )
 
     def load(self) -> Optional[State]:
-        cur = self._db.execute("SELECT v FROM state WHERE k='state'")
-        row = cur.fetchone()
-        if not row:
-            return None
-        j = json.loads(row[0])
-        return State(
-            chain_id=j["chain_id"],
-            initial_height=j["initial_height"],
-            last_block_height=j["last_block_height"],
-            last_block_id=bid_from_j(j["last_block_id"]),
-            last_block_time=ts_from_j(j["last_block_time"]),
-            validators=_valset_from_j(j["validators"]),
-            next_validators=_valset_from_j(j["next_validators"]),
-            last_validators=_valset_from_j(j["last_validators"]),
-            last_height_validators_changed=j["lhvc"],
-            consensus_params=ConsensusParams.from_j(j.get("params")),
-            app_hash=bytes.fromhex(j["app_hash"]),
-            last_results_hash=bytes.fromhex(j["last_results_hash"]),
-        )
+        with self._lock:
+            cur = self._db.execute("SELECT v FROM state WHERE k='state'")
+            row = cur.fetchone()
+            if not row:
+                return None
+            j = json.loads(row[0])
+            return State(
+                chain_id=j["chain_id"],
+                initial_height=j["initial_height"],
+                last_block_height=j["last_block_height"],
+                last_block_id=bid_from_j(j["last_block_id"]),
+                last_block_time=ts_from_j(j["last_block_time"]),
+                validators=_valset_from_j(j["validators"]),
+                next_validators=_valset_from_j(j["next_validators"]),
+                last_validators=_valset_from_j(j["last_validators"]),
+                last_height_validators_changed=j["lhvc"],
+                consensus_params=ConsensusParams.from_j(j.get("params")),
+                app_hash=bytes.fromhex(j["app_hash"]),
+                last_results_hash=bytes.fromhex(j["last_results_hash"]),
+            )
 
     def load_validators(self, height: int) -> Optional[ValidatorSet]:
         """The validator set responsible for signing `height`
         (state/store.go LoadValidators)."""
-        cur = self._db.execute(
-            "SELECT vals FROM validators WHERE height=?", (height,)
-        )
-        row = cur.fetchone()
-        return _valset_from_j(json.loads(row[0])) if row else None
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT vals FROM validators WHERE height=?", (height,)
+            )
+            row = cur.fetchone()
+            return _valset_from_j(json.loads(row[0])) if row else None
 
     def prune_validators(self, retain_height: int) -> None:
         """Drop validator-set history below retain_height (the pruner's
@@ -194,4 +196,5 @@ class StateStore:
             )
 
     def close(self) -> None:
-        self._db.close()
+        with self._lock:
+            self._db.close()

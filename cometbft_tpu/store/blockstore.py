@@ -38,14 +38,16 @@ class BlockStore:
             )
 
     def base(self) -> int:
-        cur = self._db.execute("SELECT MIN(height) FROM blocks")
-        r = cur.fetchone()[0]
-        return r if r is not None else 0
+        with self._lock:
+            cur = self._db.execute("SELECT MIN(height) FROM blocks")
+            r = cur.fetchone()[0]
+            return r if r is not None else 0
 
     def height(self) -> int:
-        cur = self._db.execute("SELECT MAX(height) FROM blocks")
-        r = cur.fetchone()[0]
-        return r if r is not None else 0
+        with self._lock:
+            cur = self._db.execute("SELECT MAX(height) FROM blocks")
+            r = cur.fetchone()[0]
+            return r if r is not None else 0
 
     def save_block(self, block: Block, seen_commit: Commit,
                    extended_commit=None) -> None:
@@ -84,52 +86,64 @@ class BlockStore:
             )
 
     def load_block(self, height: int) -> Optional[Block]:
-        cur = self._db.execute(
-            "SELECT block FROM blocks WHERE height=?", (height,)
-        )
-        row = cur.fetchone()
-        return serde.block_from_json(row[0]) if row and row[0] else None
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT block FROM blocks WHERE height=?", (height,)
+            )
+            row = cur.fetchone()
+            return serde.block_from_json(row[0]) if row and row[0] else None
 
     def load_block_by_hash(self, h: bytes) -> Optional[Block]:
-        cur = self._db.execute(
-            "SELECT block FROM blocks WHERE hash=?", (h,)
-        )
-        row = cur.fetchone()
-        return serde.block_from_json(row[0]) if row else None
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT block FROM blocks WHERE hash=?", (h,)
+            )
+            row = cur.fetchone()
+            return serde.block_from_json(row[0]) if row else None
 
     def load_block_commit(self, height: int) -> Optional[Commit]:
         """The commit FOR block `height`, stored in block height+1's
         LastCommit (store.go LoadBlockCommit loads it directly)."""
-        cur = self._db.execute(
-            "SELECT commit_json FROM blocks WHERE height=?", (height + 1,)
-        )
-        row = cur.fetchone()
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT commit_json FROM blocks WHERE height=?", (height + 1,)
+            )
+            row = cur.fetchone()
         if row and row[0]:
             return serde.commit_from_j(serde.json.loads(row[0]))
         return self.load_seen_commit(height)
 
     def load_seen_commit(self, height: int) -> Optional[Commit]:
-        cur = self._db.execute(
-            "SELECT seen_commit FROM blocks WHERE height=?", (height,)
-        )
-        row = cur.fetchone()
-        return (
-            serde.commit_from_j(serde.json.loads(row[0]))
-            if row and row[0] else None
-        )
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT seen_commit FROM blocks WHERE height=?", (height,)
+            )
+            row = cur.fetchone()
+            return (
+                serde.commit_from_j(serde.json.loads(row[0]))
+                if row and row[0] else None
+            )
 
     def load_extended_commit(self, height: int):
         """LoadBlockExtendedCommit (store.go:286): the seen commit WITH
         vote extensions, present only when extensions were enabled at
         save time."""
-        cur = self._db.execute(
-            "SELECT ext_commit FROM blocks WHERE height=?", (height,)
-        )
-        row = cur.fetchone()
-        return (
-            serde.extcommit_from_j(serde.json.loads(row[0]))
-            if row and row[0] else None
-        )
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT ext_commit FROM blocks WHERE height=?", (height,)
+            )
+            row = cur.fetchone()
+            return (
+                serde.extcommit_from_j(serde.json.loads(row[0]))
+                if row and row[0] else None
+            )
+
+    def remove_block(self, height: int) -> None:
+        """Delete one block row (rollback --remove-block;
+        state/rollback.go's store arm)."""
+        with self._lock, self._db:
+            self._db.execute("DELETE FROM blocks WHERE height=?",
+                             (height,))
 
     def prune_blocks(self, retain_height: int) -> int:
         """Delete blocks below retain_height (store.go:301)."""
@@ -140,4 +154,5 @@ class BlockStore:
             return cur.rowcount
 
     def close(self) -> None:
-        self._db.close()
+        with self._lock:
+            self._db.close()

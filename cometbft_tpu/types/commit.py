@@ -206,3 +206,10 @@ class ExtendedCommit:
             extension=e.extension,
             extension_signature=e.extension_signature,
         )
+
+    def validate_basic(self, extensions_enabled: bool = True) -> None:
+        """block.go ExtendedCommit.ValidateBasic: structural commit
+        checks + per-sig extension discipline."""
+        self.to_commit().validate_basic()
+        for e in self.extended_signatures:
+            e.validate_basic(extensions_enabled)

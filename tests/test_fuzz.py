@@ -1,0 +1,198 @@
+"""Fuzz tests: adversarial bytes against the attack-surface parsers.
+
+Reference: test/fuzz (mempool CheckTx, p2p SecretConnection read/write,
+jsonrpc server) + p2p/fuzz.go's fault-injecting connection. Seeded RNG
+throughout so failures reproduce.
+"""
+import json
+import random
+import socket
+import threading
+import time
+import urllib.request
+import urllib.error
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.conn.secret_connection import (
+    HandshakeError,
+    SecretConnection,
+)
+from cometbft_tpu.p2p.fuzz import FuzzConnConfig, FuzzedSocket
+
+
+def _sock_pair():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+def _handshake_pair(seed=1):
+    """Two SecretConnections over a socketpair."""
+    a, b = _sock_pair()
+    ka = PrivKey.generate(bytes([seed]) * 32)
+    kb = PrivKey.generate(bytes([seed + 1]) * 32)
+    out = {}
+
+    def srv():
+        out["b"] = SecretConnection.handshake(b, kb)
+
+    t = threading.Thread(target=srv, daemon=True)
+    t.start()
+    sca = SecretConnection.handshake(a, ka)
+    t.join(timeout=5)
+    return sca, out["b"]
+
+
+def test_secret_connection_frame_corruption_never_panics():
+    """Random bit flips in the ciphertext stream must surface as clean
+    errors (auth tag failure), never hangs or silent acceptance."""
+    rng = random.Random(1234)
+    for trial in range(12):
+        sca, scb = _handshake_pair(seed=40 + trial)
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 400)))
+        raw = scb._stream  # the raw socket under b
+
+        # a sends a frame, we corrupt bytes in flight b -> reads garbage:
+        # emulate by writing a corrupted copy of a valid frame
+        sca.write_msg(msg)
+        frame = raw.recv(65536)
+        pos = rng.randrange(len(frame))
+        bad = bytearray(frame)
+        bad[pos] ^= 0xFF
+        # feed the corrupted frame back through a fresh pair's socket
+        c, d = _sock_pair()
+        c.sendall(bytes(bad))
+        scb._stream = d
+        with pytest.raises(Exception) as ei:
+            scb.read_msg()
+        assert not isinstance(ei.value, (SystemExit, KeyboardInterrupt))
+        for s in (c, d):
+            s.close()
+
+
+def test_handshake_garbage_rejected():
+    """Random garbage during the STS handshake must error out, not hang
+    (test/fuzz p2p_secretconnection analog)."""
+    rng = random.Random(99)
+    for _ in range(8):
+        a, b = _sock_pair()
+        k = PrivKey.generate(bytes([7]) * 32)
+
+        def attacker():
+            try:
+                n = rng.randrange(1, 200)
+                b.sendall(bytes(rng.randrange(256) for _ in range(n)))
+                b.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=attacker, daemon=True)
+        t.start()
+        with pytest.raises((HandshakeError, OSError, ValueError)):
+            SecretConnection.handshake(a, k)
+        t.join(timeout=5)
+        for s in (a, b):
+            s.close()
+
+
+def test_mempool_checktx_fuzz():
+    """Random tx bytes through CheckTx: no exceptions, cache stays
+    bounded (test/fuzz mempool analog)."""
+    mp = Mempool(KVStoreApplication())
+    rng = random.Random(7)
+    for _ in range(300):
+        tx = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300)))
+        try:
+            mp.check_tx(tx)
+        except Exception as e:  # noqa: BLE001
+            pytest.fail(f"CheckTx raised on fuzz input: {e!r}")
+
+
+def test_fuzzed_socket_drops_are_survivable():
+    """MConnection over a dropping FuzzedSocket: the connection either
+    keeps delivering or dies via on_error — never hangs a thread or
+    crashes the process (p2p/fuzz.go's purpose)."""
+    sca, scb = _handshake_pair(seed=80)
+    # fuzz a's underlying socket: 20% write drops after handshake
+    sca._stream = FuzzedSocket(sca._stream, FuzzConnConfig(
+        prob_drop_rw=0.2, seed=5,
+    ))
+    got, errs = [], []
+    chans = [ChannelDescriptor(0x01, priority=1)]
+    ma = MConnection(sca, chans, lambda c, m: None,
+                     on_error=errs.append)
+    mb = MConnection(scb, chans, lambda c, m: got.append(m),
+                     on_error=errs.append)
+    ma.start()
+    mb.start()
+    try:
+        for i in range(60):
+            ma.send(0x01, b"m%d" % i, block=False)
+        deadline = time.time() + 8
+        while time.time() < deadline and not got and not errs:
+            time.sleep(0.05)
+        # some messages made it through, or the connection failed clean
+        assert got or errs
+    finally:
+        ma.stop()
+        mb.stop()
+
+
+def test_rpc_server_fuzz(tmp_path):
+    """Garbage HTTP bodies and query strings against the JSON-RPC server
+    return error responses, never hang or kill the server
+    (test/fuzz rpc_jsonrpc_server analog)."""
+    from cometbft_tpu.consensus.ticker import TimeoutParams
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.rpc.server import RPCServer
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    priv = PrivKey.generate(bytes([3]) * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    state = State.make_genesis("fuzz-chain", vals)
+    node = Node(KVStoreApplication(), state, privval=FilePV(priv),
+                home=str(tmp_path / "n0"),
+                timeouts=TimeoutParams(propose=0.4, propose_delta=0.1,
+                                       prevote=0.2, prevote_delta=0.1,
+                                       precommit=0.2, precommit_delta=0.1,
+                                       commit=0.01))
+    node.start()
+    rpc = RPCServer(node, host="127.0.0.1", port=0)
+    rpc.start()
+    base = rpc.address
+    rng = random.Random(11)
+    try:
+        bodies = [
+            b"", b"{", b"[]", b"\x00\xff" * 50, b'{"jsonrpc":"2.0"}',
+            json.dumps({"jsonrpc": "2.0", "method": "nope",
+                        "id": 1}).encode(),
+            json.dumps({"jsonrpc": "2.0", "method": "block",
+                        "params": {"height": "NaN"}, "id": 2}).encode(),
+            json.dumps({"jsonrpc": "2.0", "method": "block",
+                        "params": {"height": -(2**70)}, "id": 3}).encode(),
+        ] + [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+             for _ in range(10)]
+        for body in bodies:
+            req = urllib.request.Request(base + "/", data=body,
+                                         method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                e.read()
+            except urllib.error.URLError as e:
+                pytest.fail(f"server hung/died on {body[:20]!r}: {e}")
+        # server still sane after the abuse
+        with urllib.request.urlopen(base + "/health", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        rpc.stop()
+        node.stop()

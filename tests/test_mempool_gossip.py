@@ -1,0 +1,136 @@
+"""Mempool gossip send-state, gas-aware reap, and AppConns.
+
+Reference: mempool/reactor.go (per-peer send state — no echo to the
+sender, each tx at most once per peer), clist_mempool.go:519
+ReapMaxBytesMaxGas, proxy/multi_app_conn.go (four logical conns).
+"""
+import threading
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.proxy import AppConns
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.mempool.reactor import MempoolReactor
+
+
+class GasApp(KVStoreApplication):
+    def check_tx(self, req):
+        r = super().check_tx(req)
+        r.gas_wanted = 10
+        return r
+
+
+class _FakePeer:
+    def __init__(self, name):
+        self.name = name
+        self.got = []
+
+    def send(self, chan_id, msg):
+        self.got.append(msg)
+        return True
+
+
+class _FakeSwitch:
+    def __init__(self, peers):
+        self.peers = {p.name: p for p in peers}
+        self._peers_lock = threading.Lock()
+
+
+def test_reap_max_gas():
+    mp = Mempool(GasApp())
+    for i in range(10):
+        assert mp.check_tx(b"k%d=v" % i).code == 0
+    assert len(mp.reap()) == 10
+    # 10 gas per tx: a 35-gas budget admits exactly 3
+    assert len(mp.reap(max_gas=35)) == 3
+    assert mp.reap(max_gas=0) == []
+    assert len(mp.reap(max_bytes=11)) == 2  # byte cap still applies
+
+
+def test_no_echo_and_once_per_peer():
+    mp = Mempool(KVStoreApplication())
+    r = MempoolReactor(mp)
+    a, b, c = _FakePeer("a"), _FakePeer("b"), _FakePeer("c")
+    r.switch = _FakeSwitch([a, b, c])
+    for p in (a, b, c):
+        r.add_peer(p)
+    # tx arrives from a: relayed to b and c, never echoed to a
+    r.receive(0x30, a, b"x=1")
+    assert a.got == []
+    assert b.got == [b"x=1"] and c.got == [b"x=1"]
+    # duplicate delivery from another peer: no re-send anywhere
+    r.receive(0x30, b, b"x=1")
+    assert b.got == [b"x=1"] and c.got == [b"x=1"] and a.got == []
+    # local broadcast of a second tx reaches everyone exactly once
+    assert mp.check_tx(b"y=2").code == 0
+    r.broadcast_tx(b"y=2")
+    r.broadcast_tx(b"y=2")
+    assert a.got == [b"y=2"] and b.got.count(b"y=2") == 1
+
+
+def test_new_peer_gets_existing_pool():
+    mp = Mempool(KVStoreApplication())
+    r = MempoolReactor(mp)
+    assert mp.check_tx(b"old=1").code == 0
+    late = _FakePeer("late")
+    r.switch = _FakeSwitch([late])
+    r.add_peer(late)
+    assert late.got == [b"old=1"]
+
+
+def test_app_conns_in_process_serializes():
+    """Four conns over one app share one mutex — concurrent calls on
+    different conns never interleave inside the app."""
+    inside = []
+
+    class Probe(KVStoreApplication):
+        def check_tx(self, req):
+            inside.append(1)
+            try:
+                assert inside.count(1) - inside.count(-1) == 1, \
+                    "concurrent entry into non-thread-safe app"
+                return super().check_tx(req)
+            finally:
+                inside.append(-1)
+
+    conns = AppConns.in_process(Probe())
+    errs = []
+
+    def hammer(conn):
+        try:
+            for i in range(50):
+                conn.check_tx(abci.RequestCheckTx(tx=b"a=b"))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer, args=(c,))
+          for c in (conns.consensus, conns.mempool, conns.query,
+                    conns.snapshot)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+
+
+def test_app_conns_socket_four_connections():
+    from cometbft_tpu.abci.server import ABCISocketServer
+
+    srv = ABCISocketServer(KVStoreApplication())
+    srv.start()
+    try:
+        host, port = srv.addr[:2]
+        conns = AppConns.socket(host, port)
+        # each logical conn works independently, incl. the snapshot family
+        assert conns.query.info(abci.RequestInfo()).last_block_height == 0
+        assert conns.mempool.check_tx(
+            abci.RequestCheckTx(tx=b"s=1")
+        ).code == 0
+        assert conns.snapshot.list_snapshots() == []
+        assert conns.snapshot.load_snapshot_chunk(1, 1, 0) == b""
+        assert conns.consensus.extend_vote(
+            abci.RequestExtendVote(height=1)
+        ).vote_extension == b""
+        conns.close()
+    finally:
+        srv.stop()

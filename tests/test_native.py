@@ -1,0 +1,114 @@
+"""Native hostaccel: differential tests against hashlib.
+
+The C++ SHA-512 (cometbft_tpu/native/hostaccel.cpp) must agree with
+OpenSSL byte-for-byte on every length class (empty, sub-block,
+block-boundary, multi-block) — padding bugs live at the boundaries.
+"""
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu import native
+
+
+@pytest.fixture(scope="module")
+def have_native():
+    if not native.available():
+        pytest.skip("no g++ / native module unavailable "
+                    "(fallback path is exercised elsewhere)")
+    return True
+
+
+def test_batch_sha512_differential(have_native):
+    rng = random.Random(3)
+    # boundary lengths around the 128-byte block and the 112-byte
+    # padding threshold, plus random sizes
+    lengths = [0, 1, 63, 64, 111, 112, 113, 127, 128, 129, 255, 256,
+               1000] + [rng.randrange(0, 5000) for _ in range(40)]
+    rows = [os.urandom(n) for n in lengths]
+    out = native.batch_sha512(rows)
+    for i, r in enumerate(rows):
+        assert out[i].tobytes() == hashlib.sha512(r).digest(), \
+            f"mismatch at len {len(r)}"
+
+
+def test_ed25519_batch_digest_differential(have_native):
+    rng = random.Random(9)
+    n = 64
+    r_raw = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+    a_raw = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+    msgs = [os.urandom(rng.randrange(0, 300)) for _ in range(n)]
+    out = native.ed25519_batch_digest(r_raw, a_raw, msgs)
+    for i in range(n):
+        want = hashlib.sha512(
+            r_raw[i].tobytes() + a_raw[i].tobytes() + msgs[i]
+        ).digest()
+        assert out[i].tobytes() == want
+
+
+def test_pack_batch_uses_native_and_agrees(have_native):
+    """pack_batch output must be identical native vs fallback."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(16)]
+    msgs = [b"msg-%d" % i for i in range(16)]
+    pubs = [p.pub_key().data for p in privs]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    pb1 = ek.pack_batch(pubs, msgs, sigs)
+
+    real_load = native._load
+    try:
+        native._load = lambda: None  # force fallback
+        pb2 = ek.pack_batch(pubs, msgs, sigs)
+    finally:
+        native._load = real_load
+    for f in ("ay", "asign", "ry", "rsign", "sdig", "hdig", "precheck"):
+        np.testing.assert_array_equal(getattr(pb1, f), getattr(pb2, f),
+                                      err_msg=f)
+
+
+def test_empty_rows(have_native):
+    out = native.batch_sha512([b"", b""])
+    assert out[0].tobytes() == hashlib.sha512(b"").digest()
+
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+def test_reduce_mod_l_differential(have_native):
+    """The 512->253-bit reduction vs Python bigints, incl. adversarial
+    extremes (all-0xff, values just above/below multiples of L)."""
+    rng = random.Random(17)
+    cases = [b"\x00" * 64, b"\xff" * 64,
+             (L - 1).to_bytes(64, "little"),
+             L.to_bytes(64, "little"),
+             (L + 1).to_bytes(64, "little"),
+             (L * (2**259 // L)).to_bytes(64, "little")]
+    cases += [rng.getrandbits(512).to_bytes(64, "little")
+              for _ in range(200)]
+    digs = np.frombuffer(b"".join(cases), np.uint8).reshape(-1, 64)
+    out = native.batch_reduce_mod_l(digs)
+    assert out is not None
+    for i, c in enumerate(cases):
+        want = int.from_bytes(c, "little") % L
+        got = int.from_bytes(out[i].tobytes(), "little")
+        assert got == want, f"case {i}: got {got}, want {want}"
+
+
+def test_batch_challenge_matches_fallback(have_native):
+    rng = random.Random(23)
+    n = 32
+    r_raw = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+    a_raw = np.frombuffer(os.urandom(32 * n), np.uint8).reshape(n, 32)
+    msgs = [os.urandom(rng.randrange(0, 200)) for _ in range(n)]
+    out = native.ed25519_batch_challenge(r_raw, a_raw, msgs)
+    assert out is not None
+    for i in range(n):
+        d = hashlib.sha512(r_raw[i].tobytes() + a_raw[i].tobytes()
+                           + msgs[i]).digest()
+        want = int.from_bytes(d, "little") % L
+        assert int.from_bytes(out[i].tobytes(), "little") == want

@@ -75,8 +75,19 @@ def test_ristretto_roundtrip():
 def test_ristretto_rejects_noncanonical():
     assert rist.decode((rist.P + 2).to_bytes(32, "little")) is None  # >= p
     assert rist.decode((1).to_bytes(32, "little")) is None  # negative (odd)
-    # a random even value < p is almost surely not on the curve surface
-    assert rist.decode((6).to_bytes(32, "little")) is None
+    # sqrt-ratio failures must reject, and everything that DOES decode
+    # must round-trip to the identical canonical bytes (decode is a
+    # bijection onto its image — RFC 9496 §4.3.1); small even s values
+    # split roughly half and half between the two cases
+    rejected = 0
+    for s in range(0, 60, 2):
+        b = s.to_bytes(32, "little")
+        pt = rist.decode(b)
+        if pt is None:
+            rejected += 1
+        else:
+            assert rist.encode(pt) == b
+    assert rejected >= 10
 
 
 def test_sign_verify_roundtrip():
