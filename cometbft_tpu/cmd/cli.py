@@ -82,6 +82,8 @@ def build_node(home: str, cfg=None):
             f"unknown proxy_app {cfg.base.proxy_app!r} (in-process apps: "
             f"kvstore; socket ABCI arrives with the abci server)"
         )
+    import json as _json
+
     node = Node(
         KVStoreApplication(),
         doc.make_state(),
@@ -92,6 +94,8 @@ def build_node(home: str, cfg=None):
         p2p=True,
         node_key=NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json")),
         blocksync=cfg.base.blocksync,
+        app_state_bytes=(_json.dumps(doc.app_state).encode()
+                         if doc.app_state else b""),
     )
     return node, cfg
 
@@ -198,6 +202,133 @@ def cmd_reset(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """rollback.go: rewind state by one height so the node re-applies
+    the last block (e.g. after a bad upgrade produced a wrong app hash).
+    With --remove-block the block itself is deleted too."""
+    from cometbft_tpu.state.state import StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+
+    data = os.path.join(args.home, "data")
+    if not os.path.isdir(data):
+        print(f"nothing to roll back (no data dir at {data})")
+        return 1
+    ss = StateStore(os.path.join(data, "state.db"))
+    bs = BlockStore(os.path.join(data, "blockstore.db"))
+    state = ss.load()
+    if state is None or state.last_block_height < 1:
+        print("nothing to roll back")
+        return 1
+    h = state.last_block_height
+    rolled = rollback_state(state, ss, bs)
+    ss.save(rolled)
+    if args.remove_block:
+        bs.remove_block(h)
+    print(f"Rolled back state to height {rolled.last_block_height} "
+          f"and app hash {rolled.app_hash.hex()}")
+    return 0
+
+
+def rollback_state(state, ss, bs):
+    """state/rollback.go Rollback: reconstruct the post-(H-1) state from
+    block H's header + the validator-set history."""
+    from dataclasses import replace
+
+    h = state.last_block_height
+    block = bs.load_block(h)
+    if block is None:
+        raise SystemExit(f"block {h} not found; cannot roll back")
+    prev = bs.load_block(h - 1)
+    vals = ss.load_validators(h)
+    next_vals = ss.load_validators(h + 1) or state.validators
+    last_vals = ss.load_validators(h - 1)
+    if vals is None:
+        raise SystemExit(f"no validator history for height {h}")
+    return replace(
+        state,
+        last_block_height=h - 1,
+        last_block_id=block.header.last_block_id,
+        last_block_time=(prev.header.time if prev is not None
+                         else state.last_block_time),
+        validators=vals,
+        next_validators=next_vals,
+        last_validators=last_vals,
+        app_hash=block.header.app_hash,
+        last_results_hash=block.header.last_results_hash,
+    )
+
+
+def cmd_compact(args) -> int:
+    """compact.go analog: VACUUM every sqlite database in data/."""
+    import sqlite3
+
+    data = os.path.join(args.home, "data")
+    n = 0
+    for name in sorted(os.listdir(data) if os.path.isdir(data) else []):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(data, name)
+        before = os.path.getsize(path)
+        conn = sqlite3.connect(path)
+        conn.execute("VACUUM")
+        conn.close()
+        after = os.path.getsize(path)
+        print(f"{name}: {before} -> {after} bytes")
+        n += 1
+    print(f"Compacted {n} databases")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """inspect.go: read-only RPC over a stopped node's data dirs."""
+    from cometbft_tpu.inspect import InspectServer
+
+    host, port = _parse_addr(args.laddr)
+    srv = InspectServer(os.path.join(args.home, "data"), host, port)
+    srv.start()
+    print(f"inspect rpc listening on {srv.address} (read-only)")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop and (args.run_for <= 0
+                            or time.time() < args._t0 + args.run_for):
+            time.sleep(0.2)
+    finally:
+        srv.stop()
+    return 0
+
+
+def cmd_light(args) -> int:
+    """light.go: run a verifying light-client RPC proxy against a
+    primary full node + witnesses."""
+    from cometbft_tpu.light.proxy import LightProxy
+
+    host, port = _parse_addr(args.laddr)
+    proxy = LightProxy(
+        chain_id=args.chain_id,
+        primary=args.primary,
+        witnesses=[w for w in args.witnesses.split(",") if w],
+        trusted_height=args.trusted_height,
+        trusted_hash=bytes.fromhex(args.trusted_hash)
+        if args.trusted_hash else b"",
+        host=host, port=port,
+    )
+    proxy.start()
+    print(f"light proxy listening on {proxy.address} "
+          f"(primary {args.primary})")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop and (args.run_for <= 0
+                            or time.time() < args._t0 + args.run_for):
+            time.sleep(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="cometbft_tpu",
@@ -234,6 +365,39 @@ def main(argv=None) -> int:
                        help="wipe chain data (keeps keys + config)")
     _home_arg(p)
     p.set_defaults(fn=cmd_reset)
+
+    p = sub.add_parser("rollback", help="rewind state by one height")
+    _home_arg(p)
+    p.add_argument("--remove-block", action="store_true",
+                   help="also delete the rolled-back block")
+    p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser("compact", help="VACUUM the sqlite databases")
+    _home_arg(p)
+    p.set_defaults(fn=cmd_compact)
+
+    p = sub.add_parser("inspect",
+                       help="read-only RPC over a stopped node's data")
+    _home_arg(p)
+    p.add_argument("--laddr", default="tcp://127.0.0.1:26661")
+    p.add_argument("--run-for", type=float, default=0)
+    p.set_defaults(fn=cmd_inspect)
+
+    from cometbft_tpu.abci.cli import add_abci_subcommands
+
+    add_abci_subcommands(sub)
+
+    p = sub.add_parser("light", help="verifying light-client RPC proxy")
+    p.add_argument("chain_id")
+    p.add_argument("--primary", required=True,
+                   help="primary full-node RPC url")
+    p.add_argument("--witnesses", default="",
+                   help="comma-separated witness RPC urls")
+    p.add_argument("--trusted-height", type=int, default=0)
+    p.add_argument("--trusted-hash", default="")
+    p.add_argument("--laddr", default="tcp://127.0.0.1:26658")
+    p.add_argument("--run-for", type=float, default=0)
+    p.set_defaults(fn=cmd_light)
 
     args = parser.parse_args(argv)
     args._t0 = time.time()

@@ -1,0 +1,219 @@
+"""Light proxy: a verifying RPC server backed by a light client.
+
+Reference: light/proxy/proxy.go + light/rpc/client.go — an RPC endpoint
+that looks like a full node but verifies every header it returns
+through the light client (bisection from a trusted root, witness
+cross-checks) before handing it to the caller. Block data is checked
+against the verified header's hashes, so a lying primary cannot feed
+the caller fabricated blocks.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qsl, urlparse
+
+from cometbft_tpu.rpc.client import HTTPClient, light_provider
+from cometbft_tpu.types import serde
+
+
+class LightProxyError(Exception):
+    pass
+
+
+class LightProxy:
+    def __init__(self, chain_id: str, primary: str,
+                 witnesses: Optional[List[str]] = None,
+                 trusted_height: int = 0, trusted_hash: bytes = b"",
+                 trusting_period: float = 14 * 24 * 3600.0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 batch_fn=None):
+        from cometbft_tpu.light.client import Client
+
+        self.chain_id = chain_id
+        self.http = HTTPClient(primary)
+        self.client = Client(
+            chain_id,
+            light_provider(chain_id, primary),
+            witnesses=[light_provider(chain_id, w)
+                       for w in (witnesses or [])],
+            trusting_period=trusting_period,
+            batch_fn=batch_fn,
+        )
+        self._trusted_height = trusted_height
+        self._trusted_hash = trusted_hash
+        self._boot_lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
+        self.httpd.proxy = self  # type: ignore[attr-defined]
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- trust bootstrap ---------------------------------------------------
+
+    def _ensure_trust(self) -> None:
+        """initializeWithTrustOptions (light/client.go): fetch the block
+        at the trusted height and pin it against the operator-supplied
+        hash. Lazy so the proxy can start before the primary."""
+        with self._boot_lock:
+            if self.client.store.latest() is not None:
+                return
+            h = self._trusted_height
+            if h <= 0:
+                h = int(self.http.status()["sync_info"]
+                        ["latest_block_height"])
+            lb = self.client.primary.light_block(h)
+            got = lb.signed_header.header.hash()
+            if self._trusted_hash and got != self._trusted_hash:
+                raise LightProxyError(
+                    f"trusted hash mismatch at height {h}: got "
+                    f"{got.hex()}, want {self._trusted_hash.hex()}"
+                )
+            self.client.trust_light_block(lb)
+
+    # -- verified routes (light/rpc/client.go) -----------------------------
+
+    def commit(self, height=None):
+        self._ensure_trust()
+        if height is None:
+            height = int(self.http.status()["sync_info"]
+                         ["latest_block_height"])
+        lb = self.client.verify_light_block_at_height(int(height))
+        return {
+            "signed_header": {
+                "header": serde.header_to_j(lb.signed_header.header),
+                "commit": serde.commit_to_j(lb.signed_header.commit),
+            },
+            "canonical": True,
+            "verified": True,
+        }
+
+    def block(self, height=None):
+        self._ensure_trust()
+        if height is None:
+            height = int(self.http.status()["sync_info"]
+                         ["latest_block_height"])
+        lb = self.client.verify_light_block_at_height(int(height))
+        bj = self.http.block(int(height))
+        block = serde.block_from_json(json.dumps(bj["block"]))
+        if block.hash() != lb.signed_header.header.hash():
+            raise LightProxyError(
+                "primary returned a block that does not match the "
+                "verified header"
+            )
+        bj["verified"] = True
+        return bj
+
+    def validators(self, height=None):
+        self._ensure_trust()
+        if height is None:
+            height = int(self.http.status()["sync_info"]
+                         ["latest_block_height"])
+        lb = self.client.verify_light_block_at_height(int(height))
+        return {
+            "block_height": lb.height,
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": {"type": v.pub_key.key_type,
+                                "value": v.pub_key.data.hex()},
+                    "voting_power": v.voting_power,
+                    "proposer_priority": v.proposer_priority,
+                }
+                for v in lb.validator_set.validators
+            ],
+            "verified": True,
+        }
+
+    def status(self):
+        s = self.http.status()
+        latest = self.client.store.latest()
+        s["light_client"] = {
+            "trusted_height": latest.height if latest else 0,
+            "witnesses": len(self.client.witnesses),
+        }
+        return s
+
+    def health(self):
+        return {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="light-proxy",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+_PROXY_ROUTES = ("health", "status", "block", "commit", "validators")
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _reply(self, result, rid=None, code: int = 200):
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": rid, "result": result,
+        }).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_error(self, code, msg, rid=None, http: int = 200):
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": msg},
+        }).encode()
+        self.send_response(http)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch(self, method: str, params: dict, rid):
+        if method not in _PROXY_ROUTES:
+            self._reply_error(-32601, f"method {method!r} not found", rid)
+            return
+        try:
+            self._reply(getattr(self.server.proxy, method)(**params), rid)
+        except TypeError as e:
+            self._reply_error(-32602, f"invalid params: {e}", rid)
+        except Exception as e:  # noqa: BLE001 - verification failures too
+            self._reply_error(-32603, f"{e}", rid)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        method = url.path.strip("/")
+        params = dict(parse_qsl(url.query))
+        self._dispatch(method, params, None)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length).decode())
+        except Exception:
+            self._reply_error(-32700, "parse error")
+            return
+        if not isinstance(req, dict) or \
+                not isinstance(req.get("params") or {}, dict):
+            self._reply_error(-32600, "invalid request")
+            return
+        self._dispatch(req.get("method", ""), req.get("params") or {},
+                       req.get("id"))

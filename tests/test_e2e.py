@@ -1,0 +1,196 @@
+"""e2e: manifest-driven multi-PROCESS testnet with perturbations.
+
+Reference: test/e2e — TOML manifests (pkg/manifest.go) rendered into
+networks by runner/setup.go, perturbations (runner/perturb.go:44:
+kill/restart/disconnect), then black-box invariant tests over RPC
+(tests/block_test.go: all nodes agree on block hashes; chain keeps
+growing). Here the manifest is a dataclass, nodes are real OS
+processes running the operator CLI, and all assertions go through
+each node's public RPC — nothing in-process.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@dataclass
+class Manifest:
+    """test/e2e/pkg/manifest.go (subset)."""
+
+    validators: int = 3
+    chain_id: str = "e2e-chain"
+    initial_height_target: int = 3
+    perturbations: List[str] = field(default_factory=list)  # "kill:0" etc.
+
+
+class Testnet:
+    """runner/setup.go + start.go: generate homes via the CLI, run each
+    node as a subprocess, expose RPC helpers."""
+
+    __test__ = False  # pytest: not a test class despite the name
+
+    def __init__(self, manifest: Manifest, root: str):
+        self.m = manifest
+        self.root = root
+        self.procs: Dict[int, Optional[subprocess.Popen]] = {}
+        self.rpc_ports: Dict[int, int] = {}
+        r = subprocess.run(
+            [sys.executable, "-m", "cometbft_tpu", "testnet",
+             "--v", str(manifest.validators), "--output", root,
+             "--chain-id", manifest.chain_id,
+             "--p2p-port", "28800", "--rpc-port", "28900"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env=self._env(),
+        )
+        assert r.returncode == 0, r.stderr
+        # fast timeouts for the test (manifest-level tuning knob)
+        sys.path.insert(0, REPO)
+        from cometbft_tpu.config.config import load_config, save_config
+
+        for i in range(manifest.validators):
+            cpath = os.path.join(root, f"node{i}", "config",
+                                 "config.toml")
+            cfg = load_config(cpath)
+            cfg.consensus.timeout_propose = 1.0
+            cfg.consensus.timeout_propose_delta = 0.3
+            cfg.consensus.timeout_prevote = 0.5
+            cfg.consensus.timeout_prevote_delta = 0.2
+            cfg.consensus.timeout_precommit = 0.5
+            cfg.consensus.timeout_precommit_delta = 0.2
+            cfg.consensus.timeout_commit = 0.2
+            cfg.crypto.verifier = "cpu"  # no TPU in subprocesses
+            save_config(cfg, cpath)
+            self.rpc_ports[i] = 28900 + 2 * i
+
+    @staticmethod
+    def _env():
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        return env
+
+    def start_node(self, i: int) -> None:
+        home = os.path.join(self.root, f"node{i}")
+        log = open(os.path.join(home, "node.log"), "ab")
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "start",
+             "--home", home],
+            stdout=log, stderr=log, cwd=REPO, env=self._env(),
+        )
+
+    def start(self) -> None:
+        for i in range(self.m.validators):
+            self.start_node(i)
+
+    def kill_node(self, i: int) -> None:
+        """perturb.go: kill (SIGKILL, no graceful anything)."""
+        p = self.procs.get(i)
+        if p is not None:
+            p.kill()
+            p.wait(timeout=30)
+            self.procs[i] = None
+
+    def stop(self) -> None:
+        for i, p in self.procs.items():
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 15
+        for p in self.procs.values():
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+
+    # -- RPC helpers (black-box; tests/block_test.go style) ----------------
+
+    def rpc(self, i: int, method: str, timeout: float = 5.0, **params):
+        url = f"http://127.0.0.1:{self.rpc_ports[i]}/"
+        body = json.dumps({"jsonrpc": "2.0", "method": method,
+                           "params": params, "id": 1}).encode()
+        req = urllib.request.Request(url, data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            doc = json.loads(r.read())
+        if "error" in doc:
+            raise RuntimeError(doc["error"])
+        return doc["result"]
+
+    def height(self, i: int) -> int:
+        try:
+            return int(self.rpc(i, "status")["sync_info"]
+                       ["latest_block_height"])
+        except Exception:
+            return -1
+
+    def wait_for_height(self, target: int, nodes=None,
+                        timeout: float = 180.0) -> None:
+        nodes = list(nodes if nodes is not None
+                     else range(self.m.validators))
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self.height(i) >= target for i in nodes):
+                return
+            time.sleep(0.5)
+        hs = {i: self.height(i) for i in nodes}
+        raise AssertionError(f"testnet never reached {target}: {hs}")
+
+    def assert_blocks_agree(self, upto: int, nodes=None) -> None:
+        """block_test.go: every node reports the same hash per height."""
+        nodes = list(nodes if nodes is not None
+                     else range(self.m.validators))
+        for h in range(1, upto + 1):
+            hashes = set()
+            for i in nodes:
+                blk = self.rpc(i, "block", height=h)
+                hashes.add(json.dumps(blk["block_id"], sort_keys=True))
+            assert len(hashes) == 1, f"divergence at height {h}"
+
+
+@pytest.mark.slow
+def test_e2e_basic_and_kill_restart(tmp_path):
+    """The core e2e scenario: a 4-validator subprocess net makes
+    progress over real TCP + RPC; killing one validator does not halt
+    the chain (3/4 power > 2/3 quorum remains); the restarted node
+    recovers from its WAL/stores and catches back up; all nodes agree
+    on every block hash."""
+    m = Manifest(validators=4, perturbations=["kill:3", "restart:3"])
+    net = Testnet(m, str(tmp_path / "net"))
+    net.start()
+    try:
+        net.wait_for_height(2, timeout=240)
+
+        # perturbation: kill node 3 (perturb.go kill arm)
+        net.kill_node(3)
+        survivors = [0, 1, 2]
+        h = max(net.height(i) for i in survivors)
+        net.wait_for_height(h + 2, nodes=survivors, timeout=180)
+
+        # perturbation: restart (perturb.go restart arm) — node must
+        # recover from its own WAL + stores and rejoin
+        net.start_node(3)
+        target = max(net.height(i) for i in survivors) + 2
+        net.wait_for_height(target, timeout=240)
+
+        net.assert_blocks_agree(2)
+    finally:
+        net.stop()
+        for i in range(m.validators):
+            logp = os.path.join(str(tmp_path / "net"), f"node{i}",
+                                "node.log")
+            if os.path.exists(logp):
+                with open(logp, "rb") as f:
+                    tail = f.read()[-800:]
+                print(f"--- node{i} log tail ---\n"
+                      f"{tail.decode(errors='replace')}")

@@ -1,0 +1,67 @@
+"""XChaCha20-Poly1305 AEAD.
+
+The HChaCha20 core is differentially tested against OpenSSL's ChaCha20
+(the `cryptography` library): HChaCha20's output equals the ChaCha20
+block-function state WITHOUT the feed-forward, so subtracting the
+initial state words from the keystream recovers it exactly.
+"""
+import os
+import struct
+
+import pytest
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
+
+from cometbft_tpu.crypto import symmetric as sym
+
+
+def _hchacha_via_openssl(key: bytes, nonce16: bytes) -> bytes:
+    """Independent HChaCha20 from OpenSSL's ChaCha20 keystream."""
+    cipher = Cipher(algorithms.ChaCha20(key, nonce16), mode=None)
+    ks = cipher.encryptor().update(b"\x00" * 64)
+    ks_words = struct.unpack("<16L", ks)
+    init = list(sym._SIGMA) + list(struct.unpack("<8L", key)) + \
+        list(struct.unpack("<4L", nonce16))
+    M = 0xFFFFFFFF
+    out = [(ks_words[i] - init[i]) & M for i in (0, 1, 2, 3)] + \
+          [(ks_words[i] - init[i]) & M for i in (12, 13, 14, 15)]
+    return struct.pack("<8L", *out)
+
+
+def test_hchacha20_differential_vs_openssl():
+    rnd = os.urandom
+    for _ in range(20):
+        key, nonce16 = rnd(32), rnd(16)
+        assert sym.hchacha20(key, nonce16) == \
+            _hchacha_via_openssl(key, nonce16)
+
+
+def test_seal_open_roundtrip_and_tamper():
+    key = os.urandom(32)
+    aead = sym.XChaCha20Poly1305(key)
+    nonce = os.urandom(24)
+    pt = b"the validator key file contents"
+    ct = aead.seal(nonce, pt, aad=b"meta")
+    assert aead.open(nonce, ct, aad=b"meta") == pt
+    with pytest.raises(InvalidTag):
+        aead.open(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), aad=b"meta")
+    with pytest.raises(InvalidTag):
+        aead.open(nonce, ct, aad=b"other")
+    with pytest.raises(InvalidTag):
+        sym.XChaCha20Poly1305(os.urandom(32)).open(nonce, ct, b"meta")
+
+
+def test_sealed_blob_convenience():
+    key = os.urandom(32)
+    blob = sym.seal_with_random_nonce(key, b"hello")
+    assert sym.open_sealed(key, blob) == b"hello"
+    with pytest.raises(ValueError):
+        sym.open_sealed(key, b"short")
+
+
+def test_bad_lengths():
+    with pytest.raises(ValueError):
+        sym.XChaCha20Poly1305(b"short")
+    aead = sym.XChaCha20Poly1305(os.urandom(32))
+    with pytest.raises(ValueError):
+        aead.seal(os.urandom(12), b"x")  # 12B nonce is the IETF size
