@@ -51,6 +51,10 @@ def cmd_serve(args) -> int:
 
 
 def _run_one(client, cmd: str, argv: list) -> None:
+    if cmd in ("check_tx", "query") and not argv:
+        print(f"usage: {cmd} <{'tx' if cmd == 'check_tx' else 'key'}> "
+              f"(string or 0x-hex)")
+        return
     if cmd == "info":
         r = client.info(abci.RequestInfo())
         print(f"-> data: {r.data!r} height: {r.last_block_height} "

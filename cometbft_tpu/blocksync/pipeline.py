@@ -68,6 +68,7 @@ class StreamVerifier:
                  min_device_sigs: int = 129):
         self.max_sigs = max_sigs
         self.use_pallas = use_pallas
+        self._vs_cache = {}
         # below this many rows the device pass loses to a host verify
         # loop (dispatch + compile economics — the shouldBatchVerify gate,
         # types/validation.go:13-17, applied to the streaming path)
@@ -75,27 +76,60 @@ class StreamVerifier:
 
     # -- packing -----------------------------------------------------------
 
+    def _valset_arrays(self, vs):
+        """(pub_bytes_list, power_list, all_32B) per ValidatorSet,
+        cached by identity — the streaming loop re-reads one set for
+        hundreds of consecutive commits."""
+        cached = self._vs_cache.get(id(vs))
+        if cached is not None and cached[3] is vs:
+            return cached[:3]
+        keys = [v.pub_key.data for v in vs.validators]
+        powers = [v.voting_power for v in vs.validators]
+        keys_ok = all(len(k) == 32 for k in keys)
+        if len(self._vs_cache) > 8:
+            self._vs_cache.clear()
+        # the valset itself rides in the entry so an id() collision with
+        # a garbage-collected set can never alias
+        self._vs_cache[id(vs)] = (keys, powers, keys_ok, vs)
+        return keys, powers, keys_ok
+
     def _pack_chunk(self, jobs) -> Optional[_Chunk]:
         """jobs: [(global_idx, CommitJob)] for this chunk."""
+        from cometbft_tpu import native
+        from cometbft_tpu.types import canonical
+
         pubs: List[bytes] = []
-        msgs: List[bytes] = []
         sigs: List[bytes] = []
         row_job: List[int] = []
         row_idx: List[int] = []
         powers: List[int] = []
+        row_ts: List[tuple] = []
+        well_formed = True
+        native_possible = native.available()
         for j, (_, job) in enumerate(jobs):
-            for idx, cs in enumerate(job.commit.signatures):
-                if not cs.for_block():
-                    continue
-                val = job.vals.get_by_index(idx)
-                if val is None:
-                    continue
-                pubs.append(val.pub_key.data)
-                msgs.append(job.commit.vote_sign_bytes(job.chain_id, idx))
-                sigs.append(cs.signature)
-                row_job.append(j)
-                row_idx.append(idx)
-                powers.append(val.voting_power)
+            # per-valset key/power staging is cached (sync streams reuse
+            # one set across hundreds of commits); the per-commit work is
+            # a handful of comprehensions, not a 6-append row loop
+            keys, vpowers, keys_ok = self._valset_arrays(job.vals)
+            css = job.commit.signatures
+            nvals = len(keys)
+            idxs = [i for i, cs in enumerate(css)
+                    if cs.for_block() and i < nvals]
+            if not idxs:
+                continue
+            pubs += [keys[i] for i in idxs]
+            sigs += [css[i].signature for i in idxs]
+            if native_possible:  # consumed only by the native fast path
+                row_ts += [
+                    (css[i].timestamp.seconds, css[i].timestamp.nanos)
+                    for i in idxs
+                ]
+            row_job += [j] * len(idxs)
+            row_idx += idxs
+            powers += [vpowers[i] for i in idxs]
+            if not keys_ok or any(len(css[i].signature) != 64
+                                  for i in idxs):
+                well_formed = False  # numpy path screens bad rows
         if not pubs:
             return None
         n = len(pubs)
@@ -105,7 +139,34 @@ class StreamVerifier:
             pad = kp.pad_to_tile(n)
         else:
             pad = ek.bucket_size(n)
-        pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
+        # native fast path: sign-bytes are assembled in C from one
+        # (pre, suf) template per commit + per-row timestamps — the
+        # hottest host loop of streaming verification never builds
+        # Python message objects at all
+        packed = None
+        if well_formed and native_possible:
+            templates = []
+            for _, job in jobs:
+                enc = canonical.CanonicalVoteEncoder(
+                    job.chain_id, canonical.PRECOMMIT_TYPE,
+                    job.commit.height, job.commit.round,
+                    job.commit.block_id,
+                )
+                templates.append((enc._pre, enc._suf))
+            packed = native.ed25519_pack_commits(
+                b"".join(pubs), b"".join(sigs), templates,
+                np.asarray(row_job, np.int32),
+                np.asarray([s for s, _ in row_ts], np.int64),
+                np.asarray([nn for _, nn in row_ts], np.int64), pad,
+            )
+        if packed is not None:
+            pb = ek.PackedBatch(n, pad, *packed)
+        else:
+            msgs = [
+                jobs[j][1].commit.vote_sign_bytes(jobs[j][1].chain_id, idx)
+                for j, idx in zip(row_job, row_idx)
+            ]
+            pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
         power5 = np.zeros((pad, ek.POWER_LIMBS), np.int32)
         power5[:n] = ek.power_limbs(np.asarray(powers, np.int64))
         counted = np.zeros((pad,), np.bool_)

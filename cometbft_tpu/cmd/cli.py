@@ -304,6 +304,13 @@ def cmd_light(args) -> int:
     primary full node + witnesses."""
     from cometbft_tpu.light.proxy import LightProxy
 
+    if not args.trusted_hash and not args.insecure_trust:
+        print("light: refusing to start without --trusted-hash; a "
+              "lying primary could pick your trust root. Pass "
+              "--insecure-trust to accept trust-on-first-use (dev only).",
+              file=sys.stderr)
+        return 1
+
     host, port = _parse_addr(args.laddr)
     proxy = LightProxy(
         chain_id=args.chain_id,
@@ -395,7 +402,11 @@ def main(argv=None) -> int:
                    help="comma-separated witness RPC urls")
     p.add_argument("--trusted-height", type=int, default=0)
     p.add_argument("--trusted-hash", default="")
-    p.add_argument("--laddr", default="tcp://127.0.0.1:26658")
+    p.add_argument("--insecure-trust", action="store_true",
+                   help="allow trust-on-first-use without a pinned hash")
+    # 8888 like the reference light proxy — NOT in the 2665x node-port
+    # range (26658 is the conventional ABCI proxy_app port)
+    p.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     p.add_argument("--run-for", type=float, default=0)
     p.set_defaults(fn=cmd_light)
 

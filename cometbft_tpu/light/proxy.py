@@ -59,6 +59,17 @@ class LightProxy:
         with self._boot_lock:
             if self.client.store.latest() is not None:
                 return
+            if not self._trusted_hash:
+                # trust-on-first-use: the primary picks the root — fine
+                # for dev, a real deployment must pin the hash (the
+                # reference REQUIRES TrustOptions for this reason)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "light proxy: NO --trusted-hash pinned; trusting "
+                    "whatever the primary serves first (INSECURE against "
+                    "a lying primary)"
+                )
             h = self._trusted_height
             if h <= 0:
                 h = int(self.http.status()["sync_info"]

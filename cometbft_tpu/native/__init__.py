@@ -76,10 +76,17 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.batch_reduce_mod_l.argtypes = [u8p, ctypes.c_uint64, u8p]
         lib.batch_reduce_mod_l.restype = None
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         lib.ed25519_pack.argtypes = [u8p, u8p, u8p, u64p, u64p,
                                      ctypes.c_uint64, i32p, i32p, i32p,
                                      i32p, i32p, i32p, u8p]
         lib.ed25519_pack.restype = None
+        lib.ed25519_pack_commits.argtypes = [
+            u8p, u8p, u8p, u64p, u64p, u64p, u64p,
+            i32p, i64p, i64p, ctypes.c_uint64,
+            i32p, i32p, i32p, i32p, i32p, i32p, u8p,
+        ]
+        lib.ed25519_pack_commits.restype = None
         _lib = lib
         return _lib
 
@@ -194,6 +201,55 @@ def ed25519_pack(pub_cat: bytes, sig_cat: bytes,
             np.ascontiguousarray(pubs), np.ascontiguousarray(sigs),
             mdata, moffs, mlens, n,
             ay, asign, ry, rsign, sdig, hdig, precheck,
+        )
+    return ay, asign, ry, rsign, sdig, hdig, precheck.astype(np.bool_)
+
+
+def ed25519_pack_commits(pub_cat: bytes, sig_cat: bytes,
+                         templates, row_tmpl: np.ndarray,
+                         row_secs: np.ndarray, row_nanos: np.ndarray,
+                         padded: int):
+    """Fused streamed-chunk pack: canonical sign-bytes are built
+    in-native from (per-commit template, per-row timestamp) — no Python
+    message list at all. `templates` is [(pre_bytes, suf_bytes)];
+    row_tmpl[i] indexes it. Returns the same tuple as ed25519_pack, or
+    None without the native library."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(row_tmpl)
+    ay = np.zeros((padded, 20), np.int32)
+    ry = np.zeros((padded, 20), np.int32)
+    asign = np.zeros(padded, np.int32)
+    rsign = np.zeros(padded, np.int32)
+    sdig = np.zeros((padded, 64), np.int32)
+    hdig = np.zeros((padded, 64), np.int32)
+    precheck = np.zeros(padded, np.uint8)
+    if n:
+        chunks, pre_off, pre_len, suf_off, suf_len = [], [], [], [], []
+        pos = 0
+        for pre, suf in templates:
+            pre_off.append(pos)
+            pre_len.append(len(pre))
+            pos += len(pre)
+            suf_off.append(pos)
+            suf_len.append(len(suf))
+            pos += len(suf)
+            chunks.append(pre)
+            chunks.append(suf)
+        tmpl = np.frombuffer(b"".join(chunks), np.uint8)
+        if tmpl.size == 0:
+            tmpl = np.zeros(1, np.uint8)
+        lib.ed25519_pack_commits(
+            np.ascontiguousarray(np.frombuffer(pub_cat, np.uint8)),
+            np.ascontiguousarray(np.frombuffer(sig_cat, np.uint8)),
+            np.ascontiguousarray(tmpl),
+            np.asarray(pre_off, np.uint64), np.asarray(pre_len, np.uint64),
+            np.asarray(suf_off, np.uint64), np.asarray(suf_len, np.uint64),
+            np.ascontiguousarray(row_tmpl, dtype=np.int32),
+            np.ascontiguousarray(row_secs, dtype=np.int64),
+            np.ascontiguousarray(row_nanos, dtype=np.int64),
+            n, ay, asign, ry, rsign, sdig, hdig, precheck,
         )
     return ay, asign, ry, rsign, sdig, hdig, precheck.astype(np.bool_)
 

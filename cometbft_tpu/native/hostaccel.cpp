@@ -322,6 +322,98 @@ inline bool below_l(const u8* s32) {
 
 extern "C" {
 
+// -- canonical sign-bytes templating (types/canonical.py
+// CanonicalVoteEncoder): within a commit only the timestamp varies, so
+// each row's message is
+//   uvarint(len(body)) || pre || 0x2a || uvarint(len(ts)) || ts || suf
+// with ts = f_varint(1, seconds) + f_varint(2, nanos) (zero fields
+// omitted; negatives as 64-bit two's-complement 10-byte varints —
+// libs/protoenc.py rules, byte-identical by differential test).
+
+namespace {
+
+inline int put_uvarint(u8* p, u64 v) {
+  int i = 0;
+  while (v >= 0x80) {
+    p[i++] = (u8)(v | 0x80);
+    v >>= 7;
+  }
+  p[i++] = (u8)v;
+  return i;
+}
+
+// f_varint(field, v) for int64 values (two's complement when negative)
+inline int put_field_varint(u8* p, int field, long long v) {
+  if (v == 0) return 0;
+  int i = put_uvarint(p, (u64)(field << 3));  // wire type 0
+  i += put_uvarint(p + i, (u64)v);
+  return i;
+}
+
+inline int put_ts_body(u8* p, long long secs, long long nanos) {
+  int i = put_field_varint(p, 1, secs);
+  i += put_field_varint(p + i, 2, nanos);
+  return i;
+}
+
+}  // namespace
+
+// Fused commit pack: per-row canonical sign-bytes from (template,
+// timestamp) + SHA-512 + mod-L + limb/nibble decomposition + S<L, one
+// call per streamed chunk (blocksync/pipeline.py). tmpl holds each
+// commit's pre/suf slices.
+void ed25519_pack_commits(
+    const u8* pubs /* n x 32 */, const u8* sigs /* n x 64 */,
+    const u8* tmpl, const u64* pre_off, const u64* pre_len,
+    const u64* suf_off, const u64* suf_len,
+    const int32_t* row_tmpl, const long long* row_secs,
+    const long long* row_nanos, u64 n,
+    int32_t* ay, int32_t* asign, int32_t* ry, int32_t* rsign,
+    int32_t* sdig, int32_t* hdig, u8* precheck) {
+  Sha512 sh;
+  u8 digest[64], hred[32], masked[32];
+  u8 tsbuf[24], head[16], lenbuf[10];
+  for (u64 i = 0; i < n; i++) {
+    const u8* pk = pubs + 32 * i;
+    const u8* r = sigs + 64 * i;
+    const u8* s = sigs + 64 * i + 32;
+    int t = row_tmpl[i];
+    const u8* pre = tmpl + pre_off[t];
+    const u8* suf = tmpl + suf_off[t];
+    u64 plen = pre_len[t], slen = suf_len[t];
+
+    int tslen = put_ts_body(tsbuf, row_secs[i], row_nanos[i]);
+    int hlen = 0;
+    head[hlen++] = 0x2a;  // tag(5, BYTES)
+    hlen += put_uvarint(head + hlen, (u64)tslen);
+    u64 body_len = plen + (u64)hlen + (u64)tslen + slen;
+    int dlen = put_uvarint(lenbuf, body_len);
+
+    sh.init();
+    sh.update(r, 32);
+    sh.update(pk, 32);
+    sh.update(lenbuf, dlen);
+    sh.update(pre, plen);
+    sh.update(head, hlen);
+    sh.update(tsbuf, tslen);
+    sh.update(suf, slen);
+    sh.final(digest);
+    reduce512_mod_l(digest, hred);
+
+    memcpy(masked, pk, 32);
+    masked[31] &= 0x7F;
+    limbs13(masked, ay + 20 * i);
+    asign[i] = pk[31] >> 7;
+    memcpy(masked, r, 32);
+    masked[31] &= 0x7F;
+    limbs13(masked, ry + 20 * i);
+    rsign[i] = r[31] >> 7;
+    nibbles64(s, sdig + 64 * i);
+    nibbles64(hred, hdig + 64 * i);
+    precheck[i] = below_l(s) ? 1 : 0;
+  }
+}
+
 // Full host pack for one ed25519 batch (ops/ed25519_kernel.pack_batch
 // fast path): digests + mod-L + limb/nibble decomposition + S<L
 // precheck, one call for the whole commit.

@@ -221,11 +221,11 @@ class Routes:
                 "hash": hashlib.sha256(raw).hexdigest().upper()}
 
     def broadcast_tx_async(self, tx):
+        """Returns without waiting for a CheckTx RESULT, but the submit
+        itself runs on this thread — a node that refuses txs outright
+        (read-only inspect server) must not hand back phantom success."""
         raw = self._decode_tx(tx)
-        import threading as _t
-
-        _t.Thread(target=self.node.broadcast_tx, args=(raw,),
-                  daemon=True).start()
+        self.node.broadcast_tx(raw)
         return {"code": 0, "data": "", "log": "",
                 "hash": hashlib.sha256(raw).hexdigest().upper()}
 

@@ -44,11 +44,14 @@ class Testnet:
         self.root = root
         self.procs: Dict[int, Optional[subprocess.Popen]] = {}
         self.rpc_ports: Dict[int, int] = {}
+        # pid-derived port base so concurrent runs don't collide
+        base = 20000 + (os.getpid() % 1000) * 32
+        p2p_base, rpc_base = base, base + 16
         r = subprocess.run(
             [sys.executable, "-m", "cometbft_tpu", "testnet",
              "--v", str(manifest.validators), "--output", root,
              "--chain-id", manifest.chain_id,
-             "--p2p-port", "28800", "--rpc-port", "28900"],
+             "--p2p-port", str(p2p_base), "--rpc-port", str(rpc_base)],
             capture_output=True, text=True, cwd=REPO, timeout=120,
             env=self._env(),
         )
@@ -70,7 +73,7 @@ class Testnet:
             cfg.consensus.timeout_commit = 0.2
             cfg.crypto.verifier = "cpu"  # no TPU in subprocesses
             save_config(cfg, cpath)
-            self.rpc_ports[i] = 28900 + 2 * i
+            self.rpc_ports[i] = rpc_base + 2 * i
 
     @staticmethod
     def _env():

@@ -112,3 +112,49 @@ def test_batch_challenge_matches_fallback(have_native):
                            + msgs[i]).digest()
         want = int.from_bytes(d, "little") % L
         assert int.from_bytes(out[i].tobytes(), "little") == want
+
+
+def test_pack_commits_matches_pack_batch(have_native):
+    """The fused template+timestamp native pack must equal the
+    msgs-list pipeline byte-for-byte (sign-bytes templating included)."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_kernel as ek
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    rng = random.Random(41)
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(8)]
+    templates, row_tmpl, row_secs, row_nanos = [], [], [], []
+    pubs, sigs, msgs = [], [], []
+    for c in range(3):  # three "commits" with distinct templates
+        bid = BlockID(bytes([c]) * 32, PartSetHeader(1, bytes([c]) * 32))
+        enc = canonical.CanonicalVoteEncoder(
+            "pc-chain", canonical.PRECOMMIT_TYPE, 100 + c, c, bid)
+        templates.append((enc._pre, enc._suf))
+        for r in range(20):
+            # adversarial timestamps: zeros, negatives, huge values
+            secs = rng.choice([0, 1, -1, 2**40, -(2**40),
+                               rng.randrange(2**33)])
+            nanos = rng.choice([0, 1, 999999999, rng.randrange(10**9)])
+            ts = Timestamp(secs, nanos)
+            sb = enc.bytes_for(ts)
+            k = privs[r % 8]
+            pubs.append(k.pub_key().data)
+            sigs.append(k.sign(sb))
+            msgs.append(sb)
+            row_tmpl.append(c)
+            row_secs.append(secs)
+            row_nanos.append(nanos)
+    pad = 64
+    packed = native.ed25519_pack_commits(
+        b"".join(pubs), b"".join(sigs), templates,
+        np.asarray(row_tmpl, np.int32), np.asarray(row_secs, np.int64),
+        np.asarray(row_nanos, np.int64), pad,
+    )
+    assert packed is not None
+    want = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
+    names = ("ay", "asign", "ry", "rsign", "sdig", "hdig", "precheck")
+    for name, got in zip(names, packed):
+        np.testing.assert_array_equal(got, getattr(want, name),
+                                      err_msg=name)
