@@ -152,7 +152,7 @@ class StreamVerifier:
                     job.commit.height, job.commit.round,
                     job.commit.block_id,
                 )
-                templates.append((enc._pre, enc._suf))
+                templates.append(enc.template)
             packed = native.ed25519_pack_commits(
                 b"".join(pubs), b"".join(sigs), templates,
                 np.asarray(row_job, np.int32),

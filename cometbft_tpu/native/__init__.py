@@ -87,6 +87,9 @@ def _load() -> Optional[ctypes.CDLL]:
             i32p, i32p, i32p, i32p, i32p, i32p, u8p,
         ]
         lib.ed25519_pack_commits.restype = None
+        u64arr = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        lib.batch_keccak_f1600.argtypes = [u64arr, ctypes.c_uint64]
+        lib.batch_keccak_f1600.restype = None
         _lib = lib
         return _lib
 
@@ -252,6 +255,18 @@ def ed25519_pack_commits(pub_cat: bytes, sig_cat: bytes,
             n, ay, asign, ry, rsign, sdig, hdig, precheck,
         )
     return ay, asign, ry, rsign, sdig, hdig, precheck.astype(np.bool_)
+
+
+def batch_keccak_f1600(states: np.ndarray) -> Optional[np.ndarray]:
+    """Batched keccak permutation: (n, 25) uint64 lanes -> permuted
+    copy; None without the native library (callers keep the numpy
+    route)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.ascontiguousarray(states, dtype=np.uint64).copy()
+    lib.batch_keccak_f1600(out, out.shape[0])
+    return out
 
 
 def batch_reduce_mod_l(digests: np.ndarray) -> Optional[np.ndarray]:

@@ -449,6 +449,70 @@ void ed25519_pack(const u8* pubs /* n x 32 */, const u8* sigs /* n x 64 */,
   }
 }
 
+}  // extern "C"
+
+// ---- keccak-f[1600] ---------------------------------------------------
+// Batched permutation for the merlin/STROBE transcript host path
+// (crypto/keccak.py keccak_f1600_np) — sr25519 challenge generation runs
+// thousands of lanes of STROBE, and the numpy route spends ~200 ms per
+// 5k-row batch where C needs ~5 ms.
+
+namespace {
+
+const u64 KRC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808aULL,
+    0x8000000080008000ULL, 0x000000000000808bULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008aULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000aULL,
+    0x000000008000808bULL, 0x800000000000008bULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800aULL, 0x800000008000000aULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+
+inline u64 rotl64(u64 x, int n) {
+  return n ? (x << n) | (x >> (64 - n)) : x;
+}
+
+// rotation offsets indexed [x][y] (keccak.py _ROT layout)
+const int KROT[5][5] = {{0, 36, 3, 41, 18},
+                        {1, 44, 10, 45, 2},
+                        {62, 6, 43, 15, 61},
+                        {28, 55, 25, 21, 56},
+                        {27, 20, 39, 8, 14}};
+
+inline void f1600_one(u64* s /* 25 lanes, order x + 5y */) {
+  u64 a[5][5], b[5][5], c[5], d[5];
+  for (int y = 0; y < 5; y++)
+    for (int x = 0; x < 5; x++) a[x][y] = s[x + 5 * y];
+  for (int r = 0; r < 24; r++) {
+    for (int x = 0; x < 5; x++)
+      c[x] = a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4];
+    for (int x = 0; x < 5; x++)
+      d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++) a[x][y] ^= d[x];
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        b[y][(2 * x + 3 * y) % 5] = rotl64(a[x][y], KROT[x][y]);
+    for (int x = 0; x < 5; x++)
+      for (int y = 0; y < 5; y++)
+        a[x][y] = b[x][y] ^ (~b[(x + 1) % 5][y] & b[(x + 2) % 5][y]);
+    a[0][0] ^= KRC[r];
+  }
+  for (int y = 0; y < 5; y++)
+    for (int x = 0; x < 5; x++) s[x + 5 * y] = a[x][y];
+}
+
+}  // namespace
+
+extern "C" {
+
+// In-place batched keccak-f[1600]: states is n x 25 little-endian u64
+// lanes (x + 5y order, matching keccak.py).
+void batch_keccak_f1600(u64* states, u64 n) {
+  for (u64 i = 0; i < n; i++) f1600_one(states + 25 * i);
+}
+
 int hostaccel_abi_version() { return 1; }
 
 }  // extern "C"

@@ -78,19 +78,23 @@ _L_WORDS = np.frombuffer(int.to_bytes(ref.L, 32, "little"), np.uint8).view(
 )
 
 
-def s_below_l(s_bytes: np.ndarray) -> np.ndarray:
-    """(B, 32) uint8 little-endian S -> (B,) bool S < L, vectorized.
-
-    The malleability precheck of crypto/ed25519/ed25519.go:189 (S < order),
-    done as a lexicographic compare on 4 little-endian uint64 words."""
-    w = np.ascontiguousarray(s_bytes).view("<u8")  # (B, 4)
-    lt = np.zeros(s_bytes.shape[0], np.bool_)
-    decided = np.zeros(s_bytes.shape[0], np.bool_)
+def below_words(b: np.ndarray, mod_words: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 LE -> (B,) bool value < modulus, vectorized as a
+    lexicographic compare over 4 little-endian uint64 words."""
+    w = np.ascontiguousarray(b).view("<u8")  # (B, 4)
+    lt = np.zeros(b.shape[0], np.bool_)
+    decided = np.zeros(b.shape[0], np.bool_)
     for i in range(3, -1, -1):
-        lw = _L_WORDS[i]
-        lt |= ~decided & (w[:, i] < lw)
-        decided |= w[:, i] != lw
+        mw = mod_words[i]
+        lt |= ~decided & (w[:, i] < mw)
+        decided |= w[:, i] != mw
     return lt
+
+
+def s_below_l(s_bytes: np.ndarray) -> np.ndarray:
+    """The malleability precheck of crypto/ed25519/ed25519.go:189
+    (S < order)."""
+    return below_words(s_bytes, _L_WORDS)
 
 
 def power_limbs(powers: np.ndarray) -> np.ndarray:

@@ -73,6 +73,13 @@ class CanonicalVoteEncoder:
         self._pre = pre
         self._suf = pe.f_bytes(6, chain_id.encode())
 
+    @property
+    def template(self) -> tuple:
+        """(prefix, suffix) bytes around the spliced timestamp field —
+        the contract the native sign-bytes builder assembles against
+        (cometbft_tpu/native hostaccel ed25519_pack_commits)."""
+        return self._pre, self._suf
+
     def bytes_for(self, ts: Timestamp) -> bytes:
         body = (self._pre + pe.f_msg(5, pe.timestamp(ts.seconds, ts.nanos))
                 + self._suf)

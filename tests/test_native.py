@@ -131,7 +131,7 @@ def test_pack_commits_matches_pack_batch(have_native):
         bid = BlockID(bytes([c]) * 32, PartSetHeader(1, bytes([c]) * 32))
         enc = canonical.CanonicalVoteEncoder(
             "pc-chain", canonical.PRECOMMIT_TYPE, 100 + c, c, bid)
-        templates.append((enc._pre, enc._suf))
+        templates.append(enc.template)
         for r in range(20):
             # adversarial timestamps: zeros, negatives, huge values
             secs = rng.choice([0, 1, -1, 2**40, -(2**40),
@@ -158,3 +158,18 @@ def test_pack_commits_matches_pack_batch(have_native):
     for name, got in zip(names, packed):
         np.testing.assert_array_equal(got, getattr(want, name),
                                       err_msg=name)
+
+
+def test_batch_keccak_f1600_differential(have_native):
+    from cometbft_tpu.crypto.keccak import keccak_f1600_np
+
+    rng = np.random.default_rng(7)
+    states = rng.integers(0, 2**64, size=(33, 25), dtype=np.uint64)
+    out = native.batch_keccak_f1600(states)
+    assert out is not None
+    np.testing.assert_array_equal(out, keccak_f1600_np(states.copy()))
+    # and the all-zero state (SHA-3 theta/iota sanity)
+    z = np.zeros((1, 25), np.uint64)
+    np.testing.assert_array_equal(
+        native.batch_keccak_f1600(z), keccak_f1600_np(z.copy())
+    )
