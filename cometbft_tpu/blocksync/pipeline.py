@@ -64,7 +64,7 @@ class StreamVerifier:
     the reference's per-sig blame fallback, types/validation.go:243-250).
     """
 
-    def __init__(self, max_sigs: int = 16384, use_pallas: bool = False,
+    def __init__(self, max_sigs: int = 65536, use_pallas: bool = False,
                  min_device_sigs: int = 129):
         self.max_sigs = max_sigs
         self.use_pallas = use_pallas
@@ -306,7 +306,7 @@ class StreamVerifier:
 
 
 def make_stream_verifier(use_pallas: Optional[bool] = None,
-                         max_sigs: int = 16384) -> StreamVerifier:
+                         max_sigs: int = 65536) -> StreamVerifier:
     if use_pallas is None:
         from cometbft_tpu.crypto.batch import _accel_backend
 

@@ -41,7 +41,7 @@ POWER_MASK = (1 << POWER_LIMB_BITS) - 1
 # tally needs ceil(64/13) + headroom for carries
 TALLY_LIMBS = 6
 
-BUCKETS = (64, 256, 1024, 4096, 16384)
+BUCKETS = (64, 256, 1024, 4096, 16384, 32768, 65536)
 
 
 def bucket_size(n: int) -> int:
