@@ -36,13 +36,27 @@ class WALRecord:
     data: bytes
 
 
-class WAL:
-    """Append-only WAL on a single file (the autofile.Group rotation of
-    the reference is a capacity feature; single-file keeps crash-replay
-    semantics identical)."""
+HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # autofile defaultHeadSizeLimit
+MAX_SEGMENTS = 20                   # rotated files kept (capacity cap)
 
-    def __init__(self, path: str):
+
+class WAL:
+    """Append-only WAL with size-based rotation (the autofile.Group of
+    the reference, wal.go:57 baseWAL over group).
+
+    Rotation happens ONLY at height boundaries (right after an
+    ENDHEIGHT record): crash-replay starts at ENDHEIGHT(h-1), so
+    aligning segments to heights means a replay never needs a record
+    that predates the oldest retained segment while that height is
+    still live. Rotated segments are `<path>.NNN` (ascending age) and
+    pruned beyond MAX_SEGMENTS."""
+
+    def __init__(self, path: str,
+                 head_size_limit: int = HEAD_SIZE_LIMIT,
+                 max_segments: int = MAX_SEGMENTS):
         self.path = path
+        self.head_size_limit = head_size_limit
+        self.max_segments = max_segments
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
 
@@ -63,6 +77,32 @@ class WAL:
 
     def write_end_height(self, height: int) -> None:
         self.write_sync(END_HEIGHT, struct.pack(">q", height))
+        if self._f.tell() >= self.head_size_limit:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Head -> numbered segment, fresh head (autofile group
+        RotateFile); prune the oldest segments beyond max_segments."""
+        self._f.close()
+        seqs = self._segments()
+        nxt = (seqs[-1] + 1) if seqs else 0
+        os.replace(self.path, f"{self.path}.{nxt:03d}")
+        self._f = open(self.path, "ab")
+        seqs.append(nxt)
+        for old in seqs[: max(0, len(seqs) - self.max_segments)]:
+            try:
+                os.remove(f"{self.path}.{old:03d}")
+            except OSError:
+                pass
+
+    def _segments(self) -> list:
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path) + "."
+        out = []
+        for name in os.listdir(d):
+            if name.startswith(base) and name[len(base):].isdigit():
+                out.append(int(name[len(base):]))
+        return sorted(out)
 
     def flush_and_sync(self) -> None:
         self._f.flush()
@@ -77,25 +117,40 @@ class WAL:
     # -- replay --------------------------------------------------------------
 
     @staticmethod
+    def _paths(path: str) -> list:
+        """All files of the group, oldest first, head last."""
+        d = os.path.dirname(path) or "."
+        base = os.path.basename(path) + "."
+        segs = []
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.startswith(base) and name[len(base):].isdigit():
+                    segs.append(int(name[len(base):]))
+        out = [f"{path}.{s:03d}" for s in sorted(segs)]
+        if os.path.exists(path):
+            out.append(path)
+        return out
+
+    @staticmethod
     def iter_records(path: str) -> Iterator[WALRecord]:
-        """Decode records; stops at first corruption (torn final write is
-        normal after a crash — wal.go decoder's io.ErrUnexpectedEOF)."""
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            while True:
-                head = f.read(8)
-                if len(head) < 8:
-                    return
-                crc, length = struct.unpack(">II", head)
-                if length > MAX_MSG_SIZE:
-                    return
-                payload = f.read(length)
-                if len(payload) < length:
-                    return
-                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-                    return
-                yield WALRecord(payload[0], payload[1:])
+        """Decode records across the whole group (rotated segments then
+        head); stops at first corruption (torn final write is normal
+        after a crash — wal.go decoder's io.ErrUnexpectedEOF)."""
+        for p in WAL._paths(path):
+            with open(p, "rb") as f:
+                while True:
+                    head = f.read(8)
+                    if len(head) < 8:
+                        break
+                    crc, length = struct.unpack(">II", head)
+                    if length > MAX_MSG_SIZE:
+                        return
+                    payload = f.read(length)
+                    if len(payload) < length:
+                        return
+                    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                        return
+                    yield WALRecord(payload[0], payload[1:])
 
     @staticmethod
     def search_for_end_height(

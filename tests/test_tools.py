@@ -65,3 +65,35 @@ def test_load_and_report(tmp_path):
     # are sanity, not sign
     assert rep.min_ms <= rep.p50_ms <= rep.max_ms
     assert rep.max_ms < 60_000
+
+
+def test_wal_rotation_and_group_replay(tmp_path):
+    """autofile.Group analog: the WAL rotates at height boundaries once
+    the head exceeds its size limit; replay and ENDHEIGHT search span
+    the whole group; old segments are pruned."""
+    import os
+    import struct
+
+    from cometbft_tpu.consensus import wal as walmod
+
+    path = str(tmp_path / "cs.wal")
+    w = walmod.WAL(path, head_size_limit=2000, max_segments=3)
+    for h in range(1, 30):
+        for k in range(3):
+            w.write_sync(walmod.MSG_INFO, b"h%02d-msg%d" % (h, k) * 20)
+        w.write_end_height(h)
+    w.close()
+    segs = [f for f in os.listdir(tmp_path) if f.startswith("cs.wal.")]
+    assert segs, "never rotated"
+    assert len(segs) <= 3, f"pruning failed: {segs}"
+    # replay spans segments: the most recent heights are intact
+    recs = list(walmod.WAL.iter_records(path))
+    ends = [struct.unpack(">q", r.data)[0] for r in recs
+            if r.kind == walmod.END_HEIGHT]
+    assert ends[-1] == 29 and len(ends) >= 5
+    # ENDHEIGHT search across the group finds a recent height
+    idx = walmod.WAL.search_for_end_height(path, ends[-2])
+    assert idx is not None
+    tail = list(walmod.WAL.iter_records(path))[idx:]
+    assert any(r.kind == walmod.END_HEIGHT
+               and struct.unpack(">q", r.data)[0] == 29 for r in tail)
