@@ -310,6 +310,11 @@ def cmd_light(args) -> int:
               "--insecure-trust to accept trust-on-first-use (dev only).",
               file=sys.stderr)
         return 1
+    if args.trusted_hash and args.trusted_height <= 0:
+        print("light: --trusted-hash requires --trusted-height > 0 "
+              "(the hash pins a specific header, not 'latest')",
+              file=sys.stderr)
+        return 1
 
     host, port = _parse_addr(args.laddr)
     proxy = LightProxy(

@@ -136,14 +136,27 @@ class WAL:
         """Decode records across the whole group (rotated segments then
         head); stops at first corruption (torn final write is normal
         after a crash — wal.go decoder's io.ErrUnexpectedEOF)."""
-        for p in WAL._paths(path):
+        paths = WAL._paths(path)
+        for pi, p in enumerate(paths):
+            is_head = pi == len(paths) - 1
             with open(p, "rb") as f:
                 while True:
                     head = f.read(8)
-                    if len(head) < 8:
+                    if not head:
                         break
+                    if len(head) < 8:
+                        # a torn header is only a normal crash artifact in
+                        # the head (last) file; in a rotated segment it
+                        # means mid-stream truncation — stop like any
+                        # other corruption rather than splicing segments
+                        if is_head:
+                            break
+                        return
                     crc, length = struct.unpack(">II", head)
-                    if length > MAX_MSG_SIZE:
+                    # length==0 can pass the CRC check (crc32(b"")==0)
+                    # on a zero-filled tail; real records always carry
+                    # a kind byte, so treat it as corruption
+                    if length == 0 or length > MAX_MSG_SIZE:
                         return
                     payload = f.read(length)
                     if len(payload) < length:

@@ -42,6 +42,12 @@ class LightProxy:
             trusting_period=trusting_period,
             batch_fn=batch_fn,
         )
+        if trusted_hash and trusted_height <= 0:
+            raise LightProxyError(
+                "trusted_hash requires trusted_height > 0: the hash "
+                "pins a specific header, not whatever 'latest' is when "
+                "the proxy boots"
+            )
         self._trusted_height = trusted_height
         self._trusted_hash = trusted_hash
         self._boot_lock = threading.Lock()

@@ -30,15 +30,33 @@ _tried = False
 
 
 def _compile() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC]
+    # Compile to a per-pid temp path and os.replace() into place:
+    # concurrent processes (e.g. the multi-process e2e testnet) would
+    # otherwise interleave writes into the shared .so and a reader could
+    # dlopen a permanently corrupt file.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
         _log.info("hostaccel compile unavailable: %s", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
     if r.returncode != 0:
         _log.warning("hostaccel compile failed:\n%s", r.stderr[-2000:])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    try:
+        os.replace(tmp, _SO)
+    except OSError as e:
+        _log.warning("hostaccel install failed: %s", e)
         return False
     return True
 

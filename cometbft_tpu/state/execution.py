@@ -147,14 +147,22 @@ class BlockExecutor:
         if lc is None or not lc.signatures or \
                 state.last_validators is None:
             return None
+        if len(lc.signatures) != len(state.last_validators):
+            # commit rows and the validator set they signed for must be
+            # 1:1; a mismatch means store/valset corruption, and feeding
+            # the app zero-power rows would silently corrupt incentive
+            # logic (execution.go:449 panics here too)
+            raise ExecutionError(
+                f"commit has {len(lc.signatures)} signatures but "
+                f"last_validators has {len(state.last_validators)} "
+                f"validators (height {block.header.height})"
+            )
         votes = []
         for i, cs in enumerate(lc.signatures):
-            val = (state.last_validators.validators[i]
-                   if i < len(state.last_validators) else None)
+            val = state.last_validators.validators[i]
             votes.append(abci.VoteInfo(
-                validator_address=(val.address if val
-                                   else cs.validator_address),
-                power=val.voting_power if val else 0,
+                validator_address=val.address,
+                power=val.voting_power,
                 block_id_flag=cs.flag,
             ))
         return abci.CommitInfo(round=lc.round, votes=votes)

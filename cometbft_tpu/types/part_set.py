@@ -34,6 +34,11 @@ class Part:
     data: bytes
     proof: merkle.Proof
 
+    # log2(PartSet.MAX_TOTAL): a valid RFC-6962 proof over <=2^20 leaves
+    # never needs more aunts than this, so anything longer is garbage the
+    # receiver would otherwise buffer unverified (proof.go ValidateBasic).
+    MAX_AUNTS = 20
+
     def validate_basic(self) -> None:
         if self.index < 0:
             raise PartSetError("negative part index")
@@ -41,6 +46,14 @@ class Part:
             raise PartSetError("bad part size")
         if self.proof.index != self.index:
             raise PartSetError("part/proof index mismatch")
+        if not 0 < self.proof.total <= PartSet.MAX_TOTAL:
+            raise PartSetError("part proof total out of range")
+        if len(self.proof.leaf_hash) != 32:
+            raise PartSetError("bad proof leaf hash length")
+        if len(self.proof.aunts) > self.MAX_AUNTS:
+            raise PartSetError("too many proof aunts")
+        if any(len(a) != 32 for a in self.proof.aunts):
+            raise PartSetError("bad proof aunt length")
 
     def to_j(self) -> dict:
         return {

@@ -62,6 +62,70 @@ def test_tampered_part_rejected():
         rx.add_part(wrong)
 
 
+def test_oversized_proof_rejected():
+    """A peer cannot attach unbounded aunts/hashes to a part: the
+    receive side buffers orphan parts before proof verification, so
+    validate_basic must bound attacker-controlled proof bytes."""
+    from cometbft_tpu.crypto import merkle
+
+    ps = psmod.PartSet.from_data(os.urandom(65536 * 2))
+    good = ps.get_part(0)
+    # too many aunts
+    bloated = psmod.Part(0, good.data, merkle.Proof(
+        good.proof.total, 0, good.proof.leaf_hash,
+        [os.urandom(32)] * (psmod.Part.MAX_AUNTS + 1)))
+    with pytest.raises(psmod.PartSetError):
+        bloated.validate_basic()
+    # wrong-size aunt
+    fat = psmod.Part(0, good.data, merkle.Proof(
+        good.proof.total, 0, good.proof.leaf_hash,
+        [os.urandom(1 << 20)]))
+    with pytest.raises(psmod.PartSetError):
+        fat.validate_basic()
+    # wrong-size leaf hash
+    badleaf = psmod.Part(0, good.data, merkle.Proof(
+        good.proof.total, 0, b"\x00" * 31, list(good.proof.aunts)))
+    with pytest.raises(psmod.PartSetError):
+        badleaf.validate_basic()
+    # absurd total
+    badtotal = psmod.Part(0, good.data, merkle.Proof(
+        psmod.PartSet.MAX_TOTAL + 1, 0, good.proof.leaf_hash,
+        list(good.proof.aunts)))
+    with pytest.raises(psmod.PartSetError):
+        badtotal.validate_basic()
+    good.validate_basic()  # the honest part still passes
+
+
+def test_wal_rotated_segment_truncation_stops_replay(tmp_path):
+    """A torn header inside a ROTATED segment is mid-stream corruption:
+    replay must stop rather than splice older records onto newer ones.
+    A torn header in the head file is a normal crash artifact."""
+    import struct
+    import zlib
+
+    from cometbft_tpu.consensus.wal import WAL
+
+    def rec(payload: bytes) -> bytes:
+        body = b"\x01" + payload
+        return struct.pack(">II", zlib.crc32(body) & 0xFFFFFFFF,
+                           len(body)) + body
+
+    head = str(tmp_path / "wal")
+    # rotated segment with one good record + a 3-byte torn header
+    with open(head + ".000", "wb") as f:
+        f.write(rec(b"seg0") + b"\x00\x01\x02")
+    with open(head, "wb") as f:
+        f.write(rec(b"head0") + rec(b"head1"))
+    got = [r.data for r in WAL.iter_records(head)]
+    assert got == [b"seg0"], got  # stream stops at the rotated tear
+    # same tear in the HEAD file: records before it replay fine
+    os.truncate(head + ".000", len(rec(b"seg0")))
+    with open(head, "ab") as f:
+        f.write(b"\x00\x01")
+    got = [r.data for r in WAL.iter_records(head)]
+    assert got == [b"seg0", b"head0", b"head1"], got
+
+
 def test_single_small_part():
     ps = psmod.PartSet.from_data(b"tiny")
     assert ps.total() == 1
