@@ -193,7 +193,7 @@ class KVStoreApplication(abci.Application):
             return b""
         return chunks[chunk]
 
-    def apply_snapshot_chunk(self, index, chunk, sender) -> bool:
+    def apply_snapshot_chunk(self, index, chunk, sender):
         r = getattr(self, "_restore", None)
         if r is None or not 0 <= index < len(r["chunks"]):
             return False
@@ -202,8 +202,15 @@ class KVStoreApplication(abci.Application):
             return True
         blob = b"".join(r["chunks"])
         if hashlib.sha256(blob).digest() != r["snapshot"].hash:
-            self._restore = None
-            return False
+            # the hash covers the WHOLE snapshot, so the bad chunk can't
+            # be identified — ask the engine to refetch everything and
+            # keep the restore session open (RETRY_SNAPSHOT semantics)
+            n = len(r["chunks"])
+            r["chunks"] = [None] * n
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY_SNAPSHOT,
+                refetch_chunks=list(range(n)),
+            )
         doc = json.loads(blob.decode())
         self.state = {bytes.fromhex(k): bytes.fromhex(v)
                       for k, v in doc["state"].items()}

@@ -95,7 +95,11 @@ _ARG_METHODS = {
                        lambda r: [abci.Snapshot(**s) for s in r]),
     "offer_snapshot": (lambda a: [abci.Snapshot(**a[0])], None),
     "load_snapshot_chunk": (None, None),
-    "apply_snapshot_chunk": (None, None),
+    "apply_snapshot_chunk": (
+        None,
+        lambda r: r if isinstance(r, bool)
+        else abci.ResponseApplySnapshotChunk(**r),
+    ),
 }
 
 

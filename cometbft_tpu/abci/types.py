@@ -34,6 +34,25 @@ class Snapshot:
     metadata: bytes = b""
 
 
+# ResponseApplySnapshotChunk.Result (abci/types.proto ApplySnapshotChunk
+# result enum) — lets the app direct the statesync chunk engine:
+APPLY_CHUNK_ACCEPT = 0          # chunk applied, move on
+APPLY_CHUNK_ABORT = 1           # abort all snapshot restoration
+APPLY_CHUNK_RETRY = 2           # refetch + reapply THIS chunk
+APPLY_CHUNK_RETRY_SNAPSHOT = 3  # restart the whole snapshot
+APPLY_CHUNK_REJECT_SNAPSHOT = 4  # never try this snapshot again
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    """Rich apply result (abci Response.ApplySnapshotChunk). Apps may
+    also return a bare bool (True == ACCEPT, False == RETRY)."""
+
+    result: int = APPLY_CHUNK_ACCEPT
+    refetch_chunks: list = field(default_factory=list)
+    reject_senders: list = field(default_factory=list)
+
+
 @dataclass
 class RequestInfo:
     version: str = ""
@@ -304,5 +323,7 @@ class Application:
     def load_snapshot_chunk(self, height, fmt, chunk) -> bytes:
         return b""
 
-    def apply_snapshot_chunk(self, index, chunk, sender) -> bool:
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        """Returns bool (True == ACCEPT, False == RETRY) or a
+        ResponseApplySnapshotChunk for refetch/reject control."""
         return False

@@ -80,7 +80,9 @@ class StatesyncP2PReactor(Reactor):
                     )
                     self.syncer.add_snapshot(
                         snap,
-                        lambda i, p=peer, s=snap: self._fetch_chunk(p, s, i),
+                        lambda i, p=peer, s=snap: self._fetch_chunk(
+                            p, s, i, timeout=self.syncer.chunk_timeout),
+                        provider_id=str(getattr(peer, "node_id", peer)),
                     )
             elif t == "chunk_req":
                 data = self.app.load_snapshot_chunk(

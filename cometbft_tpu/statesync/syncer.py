@@ -12,6 +12,7 @@ The snapshot/chunk transport is pluggable: the p2p reactor
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import replace
@@ -82,24 +83,37 @@ class LightStateProvider:
 
 
 class Syncer:
-    """SyncAny (syncer.go:145) over pluggable snapshot sources."""
+    """SyncAny (syncer.go:145) over pluggable snapshot sources.
+
+    Chunks are fetched in parallel from EVERY peer offering the chosen
+    snapshot (statesync/chunks.go engine): a slow or lying provider is
+    timed out / punished and its slots re-requested from the others,
+    and fetched chunks persist in cache_dir so a restart resumes
+    instead of refetching."""
 
     def __init__(self, app: abci.Application, state_provider,
-                 chunk_timeout: float = 10.0):
+                 chunk_timeout: float = 10.0,
+                 cache_dir: Optional[str] = None):
         self.app = app
         self.state_provider = state_provider
         self.chunk_timeout = chunk_timeout
-        # snapshot discovery: {(height, format): (snapshot, fetch_chunk)}
+        self.cache_dir = cache_dir
+        # discovery: {(height, format): (snapshot, {provider_id: fetch})}
         self._snapshots: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         self._have = threading.Event()
 
     def add_snapshot(self, snapshot: abci.Snapshot,
-                     fetch_chunk: Callable[[int], Optional[bytes]]) -> None:
+                     fetch_chunk: Callable[[int], Optional[bytes]],
+                     provider_id: Optional[str] = None) -> None:
+        """Register a snapshot offer; multiple peers offering the same
+        (height, format) become parallel chunk providers."""
+        key = (snapshot.height, snapshot.format)
         with self._lock:
-            self._snapshots[(snapshot.height, snapshot.format)] = (
-                snapshot, fetch_chunk
-            )
+            snap, providers = self._snapshots.get(key, (snapshot, {}))
+            providers = dict(providers)
+            providers[provider_id or f"p{len(providers)}"] = fetch_chunk
+            self._snapshots[key] = (snap, providers)
         self._have.set()
 
     def sync_any(self, discovery_time: float = 5.0) -> State:
@@ -113,15 +127,19 @@ class Syncer:
                     self._snapshots.values(),
                     key=lambda t: -t[0].height,
                 )
-            for snapshot, fetch in candidates:
+            for snapshot, providers in candidates:
                 key = (snapshot.height, snapshot.format)
                 try:
-                    return self._sync_one(snapshot, fetch)
+                    return self._sync_one(snapshot, providers)
                 except Exception as e:  # noqa: BLE001 - ANY failure falls
                     # through to the next candidate: provider errors are
                     # often transient (e.g. the chain hasn't produced
                     # height+2 yet, which state_at needs), so each
-                    # snapshot gets a few tries before being dropped
+                    # snapshot gets a few tries before being dropped.
+                    # The chunk cache is NOT wiped here: _apply_chunks
+                    # wipes it itself on content-rejection failures; a
+                    # transient pre-fetch error must not throw away
+                    # chunks a restarted node already holds.
                     attempts[key] = attempts.get(key, 0) + 1
                     _log.warning("snapshot h=%d failed (try %d): %s",
                                  snapshot.height, attempts[key], e)
@@ -135,18 +153,106 @@ class Syncer:
             self._have.wait(timeout=0.5)
             self._have.clear()
 
-    def _sync_one(self, snapshot: abci.Snapshot, fetch_chunk) -> State:
+    def _clear_cache(self, snapshot: abci.Snapshot) -> None:
+        if not self.cache_dir:
+            return
+        import shutil
+
+        shutil.rmtree(
+            os.path.join(self.cache_dir,
+                         f"{snapshot.height}-{snapshot.format}"),
+            ignore_errors=True,
+        )
+
+    def _apply_chunks(self, snapshot, queue, fetcher, n_providers) -> None:
+        """Apply chunks in order, steering by the app's result enum
+        (syncer.go:415 applyChunks): RETRY refetches one chunk,
+        RETRY_SNAPSHOT restarts the sequence with the suspect chunks
+        refetched, ABORT/REJECT fail the snapshot."""
+        i = retries = timeouts = rounds = 0
+        max_timeouts = (n_providers + 2) * max(1, snapshot.chunks)
+        while i < snapshot.chunks:
+            chunk = queue.wait_for(i, self.chunk_timeout)
+            if chunk is None:
+                # a hung fetch must not pin its slot forever
+                queue.reclaim_expired(self.chunk_timeout)
+                timeouts += 1
+                if not fetcher.has_providers() or timeouts > max_timeouts:
+                    raise StateSyncError(
+                        f"chunk {i} unavailable ({timeouts} timeouts)"
+                    )
+                continue
+            sender = queue.sender_of(i) or ""
+            resp = self.app.apply_snapshot_chunk(i, chunk, sender)
+            if resp is True:
+                resp = abci.ResponseApplySnapshotChunk()
+            elif resp is False:
+                resp = abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_RETRY
+                )
+            for s in resp.reject_senders:  # app-identified bad senders
+                fetcher.punish(s)
+                fetcher.punish(s)  # named rejection = instant drop
+            if resp.result == abci.APPLY_CHUNK_ACCEPT:
+                i += 1
+                retries = 0
+                continue
+            if resp.result == abci.APPLY_CHUNK_RETRY:
+                fetcher.punish(queue.retry(i))
+                retries += 1
+                if retries > n_providers + 1:
+                    self._clear_cache(snapshot)
+                    raise StateSyncError(f"app rejected chunk {i}")
+                continue
+            if resp.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                rounds += 1
+                if rounds > 3:
+                    self._clear_cache(snapshot)
+                    raise StateSyncError(
+                        "snapshot kept failing verification"
+                    )
+                # senders of the refetched chunks are suspects (the hash
+                # can't name the culprit) — ONE strike per provider per
+                # round, or the honest peer that served most chunks
+                # would be dropped before the one that poisoned one
+                suspects = set()
+                for idx in resp.refetch_chunks:
+                    suspects.add(queue.retry(idx))
+                for s in suspects:
+                    fetcher.punish(s)
+                if not self.app.offer_snapshot(snapshot):
+                    self._clear_cache(snapshot)
+                    raise StateSyncError("app closed the restore session")
+                i = 0
+                continue
+            self._clear_cache(snapshot)
+            raise StateSyncError(
+                f"app aborted snapshot restore (result={resp.result})"
+            )
+
+    def _sync_one(self, snapshot: abci.Snapshot, providers) -> State:
+        from cometbft_tpu.statesync.chunks import ChunkFetcher, ChunkQueue
+
+        if callable(providers):  # single bare fetch fn (test shims)
+            providers = {"p0": providers}
         # trusted target state FIRST: the app hash to verify against
         # comes from the light client, never from the snapshot sender
         state = self.state_provider.state_at(snapshot.height)
         if not self.app.offer_snapshot(snapshot):
             raise StateSyncError("app rejected snapshot offer")
-        for i in range(snapshot.chunks):
-            chunk = fetch_chunk(i)
-            if chunk is None:
-                raise StateSyncError(f"chunk {i} unavailable")
-            if not self.app.apply_snapshot_chunk(i, chunk, ""):
-                raise StateSyncError(f"app rejected chunk {i}")
+        cache = None
+        if self.cache_dir:
+            cache = os.path.join(
+                self.cache_dir, f"{snapshot.height}-{snapshot.format}"
+            )
+        queue = ChunkQueue(snapshot.chunks, cache_dir=cache)
+        fetcher = ChunkFetcher(queue, providers,
+                               chunk_timeout=self.chunk_timeout)
+        fetcher.start()
+        try:
+            self._apply_chunks(snapshot, queue, fetcher, len(providers))
+        finally:
+            fetcher.stop()
         # verify the restored app (syncer.go verifyApp): height + hash
         # must match the light-client-trusted header
         info = self.app.info(abci.RequestInfo())

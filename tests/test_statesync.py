@@ -60,6 +60,106 @@ def test_syncer_rejects_tampered_snapshot():
         syncer.sync_any(discovery_time=0.1)
 
 
+def _snapshot_source(n_blocks=4, period=2):
+    src = KVStoreApplication()
+    src.enable_snapshots(period)
+    for h in range(1, n_blocks + 1):
+        src.finalize_block(abci.RequestFinalizeBlock(
+            txs=[b"k%d=%d" % (h, h)], height=h, hash=b"",
+            proposer_address=b"", time_seconds=0))
+        src.commit()
+    return src, src.list_snapshots()[-1]
+
+
+class _TrustingProvider:
+    """state_at that trusts the source app (chunk-engine unit tests)."""
+
+    def __init__(self, src):
+        self.src = src
+
+    def state_at(self, height):
+        from dataclasses import replace
+
+        st = State.make_genesis("x", ValidatorSet(
+            [Validator(PrivKey.generate(b"\x01" * 32).pub_key(), 1)]
+        ))
+        info = self.src.info(abci.RequestInfo())
+        return replace(st, last_block_height=height,
+                       app_hash=info.last_block_app_hash)
+
+
+def test_chunk_engine_corrupt_and_slow_providers():
+    """Sync completes although one provider serves corrupt chunks (app
+    rejects -> punished -> dropped) and another stalls past the chunk
+    timeout; the honest provider fills every reclaimed slot."""
+    src, snap = _snapshot_source()
+    assert snap.chunks >= 1
+    fetch_counts = {"evil": 0, "slow": 0, "good": 0}
+
+    def evil(i):
+        fetch_counts["evil"] += 1
+        return b"\x00garbage"  # wrong hash -> app rejects
+
+    def slow(i):
+        fetch_counts["slow"] += 1
+        time.sleep(5.0)
+        return None
+
+    def good(i):
+        fetch_counts["good"] += 1
+        return src.load_snapshot_chunk(snap.height, snap.format, i)
+
+    dst = KVStoreApplication()
+    syncer = Syncer(dst, _TrustingProvider(src), chunk_timeout=0.5)
+    syncer.add_snapshot(snap, evil, provider_id="evil")
+    syncer.add_snapshot(snap, slow, provider_id="slow")
+    syncer.add_snapshot(snap, good, provider_id="good")
+    state = syncer.sync_any(discovery_time=0.1)
+    assert state.last_block_height == snap.height
+    assert fetch_counts["good"] >= snap.chunks
+    info = dst.info(abci.RequestInfo())
+    assert info.last_block_app_hash == \
+        src.info(abci.RequestInfo()).last_block_app_hash
+
+
+def test_chunk_engine_all_providers_dead():
+    src, snap = _snapshot_source()
+    dst = KVStoreApplication()
+    syncer = Syncer(dst, _TrustingProvider(src), chunk_timeout=0.2)
+    syncer.add_snapshot(snap, lambda i: None, provider_id="dead")
+    with pytest.raises(StateSyncError):
+        syncer.sync_any(discovery_time=0.1)
+
+
+def test_chunk_cache_survives_restart(tmp_path):
+    """Chunks fetched before a crash are NOT refetched after restart:
+    the cache dir re-seeds the queue (chunks.go load-from-disk)."""
+    from cometbft_tpu.statesync.chunks import ChunkQueue
+
+    src, snap = _snapshot_source(n_blocks=6, period=2)
+    cache = str(tmp_path / "chunks")
+    q1 = ChunkQueue(snap.chunks, cache_dir=f"{cache}/{snap.height}-1")
+    i = q1.allocate()
+    q1.add(i, src.load_snapshot_chunk(snap.height, snap.format, i), "p")
+    # "crash": new queue over the same dir sees the chunk as received
+    q2 = ChunkQueue(snap.chunks, cache_dir=f"{cache}/{snap.height}-1")
+    assert q2.wait_for(i, 0.1) is not None
+    assert q2.sender_of(i) == "cache"
+    # and a full sync with the cache dir only fetches the missing ones
+    fetches = []
+
+    def good(j):
+        fetches.append(j)
+        return src.load_snapshot_chunk(snap.height, snap.format, j)
+
+    dst = KVStoreApplication()
+    syncer = Syncer(dst, _TrustingProvider(src), chunk_timeout=1.0,
+                    cache_dir=cache)
+    syncer.add_snapshot(snap, good, provider_id="good")
+    syncer.sync_any(discovery_time=0.1)
+    assert i not in fetches, "cached chunk was refetched"
+
+
 def test_statesync_node_joins_over_p2p(tmp_path):
     """A fresh node statesyncs from a running net: snapshot restore at the
     snapshot height (NO early blocks fetched), blocksync for the tail,
