@@ -29,16 +29,28 @@ class KVStoreApplication(abci.Application):
 
     # -- helpers -------------------------------------------------------------
 
+    @staticmethod
+    def _state_leaves(state: Dict[bytes, bytes], height: int):
+        """Merkle leaves: one height leaf + one canonical leaf per k/v.
+
+        The height leaf's 0xffffffff prefix can never collide with a
+        kv leaf (whose prefix is the 4-byte key length)."""
+        from cometbft_tpu.crypto.proof_ops import kv_leaf
+
+        leaves = [b"\xff\xff\xff\xff" + height.to_bytes(8, "big")]
+        leaves += [kv_leaf(k, v) for k, v in sorted(state.items())]
+        return leaves
+
     def _compute_app_hash(self, height: int) -> bytes:
-        items = sorted(self.state.items())
-        h = hashlib.sha256()
-        h.update(height.to_bytes(8, "big"))
-        for k, v in items:
-            h.update(len(k).to_bytes(4, "big"))
-            h.update(k)
-            h.update(len(v).to_bytes(4, "big"))
-            h.update(v)
-        return h.digest()
+        """Merkle root over the sorted state (PROVABLE: query with
+        prove=True returns an inclusion proof chaining a k/v to this
+        root, which the light proxy verifies against a trusted
+        header's app_hash — light/rpc/client.go:117)."""
+        from cometbft_tpu.crypto import merkle
+
+        return merkle.hash_from_byte_slices(
+            self._state_leaves(self.state, height)
+        )
 
     @staticmethod
     def _parse_val_tx(tx: bytes):
@@ -135,15 +147,35 @@ class KVStoreApplication(abci.Application):
         self.state = self.staged
         self.height = self._pending_height
         self.app_hash = self._pending_hash
+        self._committed = (dict(self.state), self.height)
         self._maybe_snapshot()
         return abci.ResponseCommit()
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
-        v = self.state.get(req.data, b"")
-        return abci.ResponseQuery(
-            key=req.data, value=v, height=self.height,
+        # one atomic read: commit() swaps in a new tuple, so (state,
+        # height) can never be torn across a concurrent commit — a torn
+        # pair would make the returned proof unverifiable
+        state, height = self._snapshot()
+        v = state.get(req.data, b"")
+        resp = abci.ResponseQuery(
+            key=req.data, value=v, height=height,
             log="exists" if v else "does not exist",
         )
+        if req.prove and v:
+            from cometbft_tpu.crypto import merkle
+            from cometbft_tpu.crypto.proof_ops import make_kv_op
+
+            leaves = self._state_leaves(state, height)
+            idx = 1 + sorted(state).index(req.data)
+            _, proofs = merkle.proofs_from_byte_slices(leaves)
+            resp.proof_ops = [make_kv_op(req.data, proofs[idx])]
+        return resp
+
+    def _snapshot(self):
+        snap = getattr(self, "_committed", None)
+        if snap is None:
+            return dict(self.state), self.height
+        return snap
 
     # -- state-sync snapshots (kvstore.go snapshot support) -----------------
 
@@ -217,5 +249,6 @@ class KVStoreApplication(abci.Application):
         self.height = doc["height"]
         self.app_hash = bytes.fromhex(doc["app_hash"])
         self.staged = dict(self.state)
+        self._committed = (dict(self.state), self.height)
         self._restore = None
         return True

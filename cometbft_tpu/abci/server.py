@@ -105,6 +105,13 @@ _ARG_METHODS = {
 
 def _rebuild(cls, doc):
     """Dataclass from decoded dict, recursing into typed list fields."""
+    if cls is abci.ResponseQuery:
+        from cometbft_tpu.crypto.proof_ops import ProofOp
+
+        ops = doc.pop("proof_ops", None) or []
+        resp = abci.ResponseQuery(**doc)
+        resp.proof_ops = [ProofOp(**o) for o in ops]
+        return resp
     if cls is abci.ResponseFinalizeBlock:
         return abci.ResponseFinalizeBlock(
             tx_results=[abci.ExecTxResult(**r) for r in doc["tx_results"]],

@@ -261,6 +261,9 @@ class ResponseQuery:
     value: bytes = b""
     height: int = 0
     log: str = ""
+    # crypto.proof_ops.ProofOp list when the request set prove=True
+    # (abci ResponseQuery.proof_ops) — chains value -> app_hash
+    proof_ops: list = field(default_factory=list)
 
 
 class Application:

@@ -97,6 +97,8 @@ def build_node(home: str, cfg=None):
         app_state_bytes=(_json.dumps(doc.app_state).encode()
                          if doc.app_state else b""),
     )
+    # the full doc backs the genesis/genesis_chunked RPCs
+    node.genesis_doc = _json.loads(doc.to_json())
     return node, cfg
 
 

@@ -916,3 +916,49 @@ class ConsensusState(BaseService):
                 return True
             time.sleep(0.01)
         return False
+
+    def round_state_json(self) -> dict:
+        """RoundState introspection for the consensus_state /
+        dump_consensus_state RPCs (consensus/types/round_state.go
+        RoundStateSimple + rpc/core/consensus.go). Read without the
+        receive routine's serialization — a snapshot for operators, not
+        a consensus input."""
+        def ba_str(ba) -> str:
+            return "".join(
+                "x" if ba.get_index(i) else "_" for i in range(ba.bits)
+            )
+
+        def votes_j(vs):
+            if vs is None:
+                return None
+            maj = vs.two_thirds_majority()
+            return {
+                "count": vs.size(),
+                "bit_array": ba_str(vs.bit_array()),
+                "two_thirds_majority": maj.hash.hex() if maj else None,
+            }
+
+        votes = self.votes
+        rounds = []
+        for r in range(self.round + 1):
+            rounds.append({
+                "round": r,
+                "prevotes": votes_j(votes.prevotes(r)),
+                "precommits": votes_j(votes.precommits(r)),
+            })
+        return {
+            "height": self.height,
+            "round": self.round,
+            "step": self.step,
+            "proposal": (self.proposal.block_id.hash.hex()
+                         if self.proposal else None),
+            "proposal_block": (self.proposal_block.hash().hex()
+                               if self.proposal_block else None),
+            "locked_round": self.locked_round,
+            "locked_block": (self.locked_block.hash().hex()
+                             if self.locked_block else None),
+            "valid_round": self.valid_round,
+            "valid_block": (self.valid_block.hash().hex()
+                            if self.valid_block else None),
+            "height_vote_set": rounds,
+        }

@@ -1,11 +1,12 @@
 """Query-filtered publish/subscribe bus.
 
 Reference: libs/pubsub (Server with query-matched subscriptions; the
-query language lives in libs/pubsub/query). This build implements the
-subset the RPC/event surface uses: exact-match conditions joined by AND
-over event tags — `tm.event='NewBlock' AND tx.height=5` — which is what
-the reference's own RPC examples exercise; the full comparison grammar
-(>,<,CONTAINS,EXISTS) can layer on without changing the bus.
+query language lives in libs/pubsub/query). Grammar: conditions joined
+by AND over event tags with =, CONTAINS, EXISTS and the numeric range
+comparisons <, >, <=, >= — `tm.event='NewBlock' AND tx.height>5`.
+Range comparisons coerce both sides to numbers (the reference compares
+int64/float64 the same way, query/query.go conditionXX); a non-numeric
+tag value simply doesn't match.
 """
 from __future__ import annotations
 
@@ -21,9 +22,25 @@ class QueryError(Exception):
 
 
 _COND = re.compile(
-    r"\s*([\w.]+)\s*(=|CONTAINS|EXISTS)\s*('(?:[^']*)'|\"(?:[^\"]*)\"|\S+)?"
-    r"\s*$"
+    r"\s*([\w.]+)\s*(<=|>=|<|>|=|CONTAINS|EXISTS)\s*"
+    r"('(?:[^']*)'|\"(?:[^\"]*)\"|\S+)?\s*$"
 )
+
+RANGE_OPS = ("<", ">", "<=", ">=")
+
+CMP = {
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def _num(s) -> Optional[float]:
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return None
 
 
 @dataclass(frozen=True)
@@ -54,6 +71,10 @@ class Query:
                 raise QueryError(f"missing value in {part!r}")
             if raw[0] in "'\"" and raw[-1] == raw[0]:
                 raw = raw[1:-1]
+            if op in RANGE_OPS and _num(raw) is None:
+                raise QueryError(
+                    f"range comparison needs a numeric value: {part!r}"
+                )
             self.conditions.append(Condition(key, op, raw))
 
     def matches(self, tags: Dict[str, List[str]]) -> bool:
@@ -68,6 +89,14 @@ class Query:
                     return False
             elif c.op == "CONTAINS":
                 if not any(c.value in v for v in vals):
+                    return False
+            elif c.op in RANGE_OPS:
+                want = _num(c.value)
+                cmp = CMP[c.op]
+                if not any(
+                    got is not None and cmp(got, want)
+                    for got in map(_num, vals)
+                ):
                     return False
         return True
 

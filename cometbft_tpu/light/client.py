@@ -27,6 +27,12 @@ from cometbft_tpu.light.verifier import (
 from cometbft_tpu.types.timestamp import Timestamp
 
 
+class NoSuchBlockError(LightClientError):
+    """Provider doesn't have the block (yet) — an AVAILABILITY error,
+    retryable, unlike verification failures (provider.ErrLightBlockNot
+    Found vs the verifier's security errors)."""
+
+
 class Provider:
     """Light-block source (light/provider/provider.go): an RPC node in the
     reference; any callable source here."""
@@ -39,7 +45,9 @@ class Provider:
     def light_block(self, height: int) -> LightBlock:
         lb = self._fetch(height)
         if lb is None:
-            raise LightClientError(f"provider has no light block {height}")
+            raise NoSuchBlockError(
+                f"provider has no light block {height}"
+            )
         return lb
 
 

@@ -9,8 +9,10 @@ the caller fabricated blocks.
 """
 from __future__ import annotations
 
+import base64
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qsl, urlparse
@@ -143,6 +145,88 @@ class LightProxy:
             "verified": True,
         }
 
+    def abci_query(self, path=None, data=None):
+        """VERIFIED query (light/rpc/client.go:117 ABCIQueryWithOptions):
+        the app must return a merkle proof, which is checked against the
+        app_hash of the light-client-verified header at resp.height+1
+        (the app hash for height H lands in header H+1). A missing or
+        bad proof is an error, never silently-unverified data."""
+        from cometbft_tpu.crypto.proof_ops import (
+            ProofError,
+            ProofOp,
+            default_runtime,
+        )
+
+        self._ensure_trust()
+        resp = self.http.call("abci_query", path=path, data=data,
+                              prove=True)["response"]
+        if int(resp.get("code", 0)) != 0:
+            return {"response": resp}  # app-level error; nothing to prove
+        value = base64.b64decode(resp.get("value") or "")
+        key = bytes.fromhex(resp.get("key") or "")
+        ops_j = (resp.get("proof_ops") or {}).get("ops") or []
+        if not value:
+            raise LightProxyError(
+                "proof of absence is not supported; empty result cannot "
+                "be verified (light/rpc/client.go:168)"
+            )
+        if not ops_j:
+            raise LightProxyError("primary returned no proof for query")
+        h = int(resp.get("height") or 0)
+        if h <= 0:
+            raise LightProxyError("primary returned no proof height")
+        # the app hash for height h lands in header h+1, which a live
+        # chain produces within a block interval — wait briefly for
+        # AVAILABILITY only; verification failures (a forged header)
+        # must surface immediately, not be retried into a timeout
+        from cometbft_tpu.light.client import NoSuchBlockError
+
+        lb = None
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                lb = self.client.verify_light_block_at_height(h + 1)
+                break
+            except NoSuchBlockError:
+                if time.time() > deadline:
+                    raise LightProxyError(
+                        f"header {h + 1} (carrying the queried app "
+                        f"hash) never appeared"
+                    )
+                time.sleep(0.25)
+        ops = [ProofOp.from_j(o) for o in ops_j]
+        try:
+            default_runtime().verify_value(
+                ops, lb.signed_header.header.app_hash, key, value
+            )
+        except ProofError as e:
+            raise LightProxyError(f"query proof verification failed: {e}")
+        resp["verified"] = True
+        return {"response": resp}
+
+    def tx(self, hash, prove=None):
+        """VERIFIED tx lookup (light/rpc/client.go Tx): the inclusion
+        proof is validated against the verified header's data_hash."""
+        from cometbft_tpu.types.tx import TxProof
+
+        self._ensure_trust()
+        r = self.http.call("tx", hash=hash, prove=True)
+        proof_j = r.get("proof")
+        if not proof_j:
+            raise LightProxyError("primary returned no tx proof")
+        tp = TxProof.from_j(proof_j)
+        lb = self.client.verify_light_block_at_height(int(r["height"]))
+        if not tp.validate(lb.signed_header.header.data_hash):
+            raise LightProxyError(
+                "tx proof does not verify against the trusted header"
+            )
+        import hashlib as _hl
+
+        if _hl.sha256(tp.data).hexdigest().upper() != hash.upper():
+            raise LightProxyError("proof is for a different tx")
+        r["verified"] = True
+        return r
+
     def status(self):
         s = self.http.status()
         latest = self.client.store.latest()
@@ -174,7 +258,8 @@ class LightProxy:
         self.httpd.server_close()
 
 
-_PROXY_ROUTES = ("health", "status", "block", "commit", "validators")
+_PROXY_ROUTES = ("health", "status", "block", "commit", "validators",
+                 "abci_query", "tx")
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):

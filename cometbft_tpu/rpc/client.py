@@ -58,10 +58,17 @@ class HTTPClient:
             {"height": height} if height is not None else {}
         ))
 
-    def validators(self, height: Optional[int] = None):
-        return self.call("validators", **(
-            {"height": height} if height is not None else {}
-        ))
+    def validators(self, height: Optional[int] = None,
+                   page: Optional[int] = None,
+                   per_page: Optional[int] = None):
+        params = {}
+        if height is not None:
+            params["height"] = height
+        if page is not None:
+            params["page"] = page
+        if per_page is not None:
+            params["per_page"] = per_page
+        return self.call("validators", **params)
 
     def broadcast_tx_commit(self, tx: bytes):
         import base64
@@ -90,7 +97,17 @@ def light_provider(chain_id: str, base_url: str):
     def fetch(height: int):
         try:
             cj = http.commit(height)
-            vj = http.validators(height)
+            # the validators route paginates (max 100/page): walk every
+            # page or sets >100 validators would silently truncate and
+            # fail the valset-hash check on every header
+            rows = []
+            page = 1
+            while True:
+                vj = http.validators(height, page=page, per_page=100)
+                rows.extend(vj["validators"])
+                if len(rows) >= int(vj["total"]) or not vj["validators"]:
+                    break
+                page += 1
         except Exception:
             return None
         header = serde.header_from_j(cj["signed_header"]["header"])
@@ -102,7 +119,7 @@ def light_provider(chain_id: str, base_url: str):
                 v["voting_power"],
                 proposer_priority=v.get("proposer_priority", 0),
             )
-            for v in vj["validators"]
+            for v in rows
         ])
         return lv.LightBlock(lv.SignedHeader(header, commit), vals)
 

@@ -91,12 +91,66 @@ class Routes:
         return {"listening": n.switch is not None,
                 "n_peers": len(peers), "peers": peers}
 
-    def genesis(self):
+    def _genesis_doc(self) -> dict:
         st = self.node.consensus.state
-        return {"genesis": {
-            "chain_id": st.chain_id,
-            "initial_height": st.initial_height,
-        }}
+        doc = getattr(self.node, "genesis_doc", None)
+        if doc:
+            return doc
+        return {"chain_id": st.chain_id,
+                "initial_height": st.initial_height}
+
+    def genesis(self):
+        return {"genesis": self._genesis_doc()}
+
+    GENESIS_CHUNK = 16 * 1024
+
+    def genesis_chunked(self, chunk=None):
+        """rpc/core/blocks.go GenesisChunked: base64 16KiB slices for
+        genesis docs too big for one response."""
+        blob = json.dumps(self._genesis_doc()).encode()
+        n = max(1, -(-len(blob) // self.GENESIS_CHUNK))
+        i = int(chunk) if chunk is not None else 0
+        if not 0 <= i < n:
+            raise RPCError(-32603, f"chunk {i} out of range (total {n})")
+        part = blob[i * self.GENESIS_CHUNK:(i + 1) * self.GENESIS_CHUNK]
+        return {"chunk": i, "total": n,
+                "data": base64.b64encode(part).decode()}
+
+    def consensus_params(self, height=None):
+        """rpc/core/consensus.go ConsensusParams (historical via the
+        state store's params history)."""
+        h = self._height_arg(height)
+        p = None
+        if hasattr(self.node.state_store, "load_consensus_params"):
+            p = self.node.state_store.load_consensus_params(h)
+        if p is None:
+            if height is not None:
+                raise RPCError(
+                    -32603, f"no consensus params recorded for {h}"
+                )
+            p = self.node.consensus.state.consensus_params
+        return {"block_height": h, "consensus_params": p.to_j()}
+
+    def consensus_state(self):
+        """rpc/core/consensus.go GetConsensusState (the operator's
+        round-progress view)."""
+        return {"round_state": self.node.consensus.round_state_json()}
+
+    def dump_consensus_state(self):
+        """rpc/core/consensus.go DumpConsensusState: full round state +
+        per-peer consensus positions."""
+        peers = []
+        cr = getattr(self.node, "consensus_reactor", None)
+        if cr is not None:
+            for peer, ps in list(cr._peer_states.items()):
+                peers.append({
+                    "node_id": getattr(peer, "peer_id", ""),
+                    "height": ps.height,
+                    "round": ps.round,
+                    "step": ps.step,
+                })
+        return {"round_state": self.node.consensus.round_state_json(),
+                "peers": peers}
 
     # -- blocks -------------------------------------------------------------
 
@@ -141,6 +195,35 @@ class Routes:
             })
         return {"last_height": latest, "block_metas": metas}
 
+    def header(self, height=None):
+        """rpc/core/blocks.go Header."""
+        h = self._height_arg(height)
+        blk = self.node.block_store.load_block(h)
+        if blk is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {"header": serde.header_to_j(blk.header)}
+
+    def header_by_hash(self, hash):
+        blk = self.node.block_store.load_block_by_hash(bytes.fromhex(hash))
+        if blk is None:
+            raise RPCError(-32603, "block not found")
+        return {"header": serde.header_to_j(blk.header)}
+
+    def block_results(self, height=None):
+        """rpc/core/blocks.go BlockResults: the stored FinalizeBlock
+        outcome for a height (state.Store.LoadFinalizeBlockResponse)."""
+        h = self._height_arg(height)
+        doc = self.node.state_store.load_abci_responses(h)
+        if doc is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": h,
+            "txs_results": doc.get("tx_results", []),
+            "validator_updates": doc.get("validator_updates", []),
+            "app_hash": doc.get("app_hash", ""),
+            "finalize_block_events": doc.get("events", {}),
+        }
+
     def commit(self, height=None):
         h = self._height_arg(height)
         blk = self.node.block_store.load_block(h)
@@ -156,11 +239,25 @@ class Routes:
             "canonical": True,
         }
 
+    @staticmethod
+    def _paginate(items, page, per_page, max_per_page: int = 100):
+        """rpc/core/env.go validatePage/validatePerPage semantics."""
+        per = int(per_page) if per_page else 30
+        per = max(1, min(per, max_per_page))
+        total_pages = max(1, -(-len(items) // per))
+        pg = int(page) if page else 1
+        if not 1 <= pg <= total_pages:
+            raise RPCError(
+                -32603, f"page {pg} out of range [1, {total_pages}]"
+            )
+        return items[(pg - 1) * per: pg * per]
+
     def validators(self, height=None, page=None, per_page=None):
         h = self._height_arg(height)
         vals = self.node.state_store.load_validators(h)
         if vals is None:
             raise RPCError(-32603, f"no validator set at height {h}")
+        window = self._paginate(vals.validators, page, per_page)
         return {
             "block_height": h,
             "validators": [
@@ -171,9 +268,9 @@ class Routes:
                     "voting_power": v.voting_power,
                     "proposer_priority": v.proposer_priority,
                 }
-                for v in vals.validators
+                for v in window
             ],
-            "count": len(vals.validators),
+            "count": len(window),
             "total": len(vals.validators),
         }
 
@@ -192,17 +289,56 @@ class Routes:
     def abci_query(self, path=None, data=None, height=None, prove=None):
         from cometbft_tpu.abci import types as abci
 
+        want_proof = prove in (True, "true", "1", 1)
         resp = self.node.app_conns.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path or "",
+            height=int(height) if height else 0,
+            prove=want_proof,
         ))
-        return {"response": {
+        out = {
             "code": resp.code,
             "key": resp.key.hex() if resp.key else "",
             "value": base64.b64encode(resp.value).decode()
             if resp.value else "",
+            "height": resp.height,
             "log": resp.log,
-        }}
+        }
+        if getattr(resp, "proof_ops", None):
+            out["proof_ops"] = {"ops": [
+                op.to_j() if hasattr(op, "to_j") else op
+                for op in resp.proof_ops
+            ]}
+        return {"response": out}
+
+    def check_tx(self, tx):
+        """rpc/core/mempool.go CheckTx: run CheckTx WITHOUT adding the
+        tx to the mempool (dry-run validity probe)."""
+        from cometbft_tpu.abci import types as abci
+
+        raw = self._decode_tx(tx)
+        resp = self.node.app_conns.query.check_tx(
+            abci.RequestCheckTx(tx=raw)
+        )
+        return {"code": resp.code, "log": resp.log,
+                "gas_wanted": getattr(resp, "gas_wanted", 0)}
+
+    def broadcast_evidence(self, evidence):
+        """rpc/core/evidence.go BroadcastEvidence: submit duplicate-vote
+        or light-client-attack evidence found out-of-band."""
+        from cometbft_tpu.types.evidence import evidence_from_j
+
+        if self.node.evidence_pool is None:
+            raise RPCError(-32603, "node has no evidence pool")
+        try:
+            ev = evidence_from_j(
+                evidence if isinstance(evidence, dict)
+                else json.loads(evidence)
+            )
+        except Exception as e:  # noqa: BLE001 - operator input
+            raise RPCError(-32602, f"malformed evidence: {e}")
+        self.node.evidence_pool.add_evidence(ev)
+        return {"hash": ev.hash().hex().upper()}
 
     # -- txs ----------------------------------------------------------------
 
@@ -257,12 +393,14 @@ class Routes:
         finally:
             self.node.event_bus.pubsub.unsubscribe_all(subscriber)
 
-    def tx(self, hash):
-        """rpc/core/tx.go Tx: look up a committed tx by hash."""
+    def tx(self, hash, prove=None):
+        """rpc/core/tx.go Tx: look up a committed tx by hash; with
+        prove=true, attach the merkle inclusion proof against the
+        block's data_hash (types/tx.go Txs.Proof)."""
         item = self.node.tx_indexer.get(bytes.fromhex(hash))
         if item is None:
             raise RPCError(-32603, f"tx {hash} not found")
-        return {
+        out = {
             "hash": item["hash"].hex().upper(),
             "height": item["height"],
             "index": item["index"],
@@ -272,14 +410,30 @@ class Routes:
                           if item["data"] else "",
                           "log": item["log"]},
         }
+        if prove in (True, "true", "1", 1):
+            from cometbft_tpu.types.tx import tx_proof
 
-    def tx_search(self, query, limit=None):
-        """rpc/core/tx.go TxSearch over the event index."""
-        items = self.node.tx_indexer.search(
-            query, int(limit) if limit else 100
-        )
+            blk = self.node.block_store.load_block(item["height"])
+            if blk is None:
+                raise RPCError(-32603, "block pruned; no proof")
+            out["proof"] = tx_proof(blk.data.txs, item["index"]).to_j()
+        return out
+
+    def tx_search(self, query, limit=None, page=None, per_page=None,
+                  order_by=None):
+        """rpc/core/tx.go TxSearch over the event index, paginated."""
+        if limit and not per_page:  # legacy param form
+            per_page = limit
+        order = "desc" if order_by == "desc" else "asc"
+        try:
+            total, items = self.node.tx_indexer.search_paged(
+                query, page=int(page) if page else 1,
+                per_page=int(per_page) if per_page else 30, order=order,
+            )
+        except ValueError as e:
+            raise RPCError(-32603, str(e))
         return {
-            "total_count": len(items),
+            "total_count": total,
             "txs": [
                 {
                     "hash": it["hash"].hex().upper(),
@@ -292,20 +446,25 @@ class Routes:
             ],
         }
 
-    def block_search(self, query, limit=None):
+    def block_search(self, query, limit=None, page=None, per_page=None,
+                     order_by=None):
         """rpc/core/blocks.go BlockSearch over the block-event index."""
         heights = self.node.block_indexer.search(
-            query, int(limit) if limit else 100
+            query, int(limit) if limit else 10_000
         )
+        if order_by == "desc":
+            heights = list(reversed(heights))
+        total = len(heights)
+        window = self._paginate(heights, page, per_page)
         blocks = []
-        for h in heights:
+        for h in window:
             blk = self.node.block_store.load_block(h)
             if blk is not None:
                 blocks.append({
                     "block_id": serde.bid_to_j(blk.block_id()),
                     "block": json.loads(serde.block_to_json(blk)),
                 })
-        return {"total_count": len(blocks), "blocks": blocks}
+        return {"total_count": total, "blocks": blocks}
 
     def unconfirmed_txs(self, limit=None):
         txs = self.node.mempool.reap(-1)
@@ -319,8 +478,11 @@ class Routes:
 
 
 _ROUTES = [
-    "health", "status", "net_info", "genesis", "block", "block_by_hash",
-    "blockchain", "commit", "validators", "abci_info", "abci_query",
+    "health", "status", "net_info", "genesis", "genesis_chunked",
+    "block", "block_by_hash", "block_results", "header",
+    "header_by_hash", "blockchain", "commit", "validators",
+    "consensus_params", "consensus_state", "dump_consensus_state",
+    "abci_info", "abci_query", "check_tx", "broadcast_evidence",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search",

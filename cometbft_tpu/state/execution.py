@@ -28,6 +28,26 @@ class ExecutionError(Exception):
     pass
 
 
+def responses_to_j(resp: abci.ResponseFinalizeBlock) -> dict:
+    """JSON form of a FinalizeBlock response for the state store
+    (block_results RPC + reindexing read this back)."""
+    return {
+        "tx_results": [
+            {"code": r.code, "data": r.data.hex(), "log": r.log,
+             "gas_wanted": r.gas_wanted, "gas_used": r.gas_used,
+             "events": getattr(r, "events", None) or {}}
+            for r in resp.tx_results
+        ],
+        "validator_updates": [
+            {"pub_key": u.pub_key.hex(), "power": u.power,
+             "key_type": u.key_type}
+            for u in resp.validator_updates
+        ],
+        "app_hash": resp.app_hash.hex(),
+        "events": getattr(resp, "events", None) or {},
+    }
+
+
 def results_hash(tx_results: List[abci.ExecTxResult]) -> bytes:
     """Merkle of deterministic ExecTxResult proto encodings
     (abci/types/types.go TxResultsHash; only code/data/gas fields are
@@ -302,6 +322,12 @@ class BlockExecutor:
                 block.evidence,
             )
         self.state_store.save(new_state)
+        if hasattr(self.state_store, "save_abci_responses"):
+            # block_results + reindex source
+            # (state/store.go SaveFinalizeBlockResponse)
+            self.state_store.save_abci_responses(
+                block.header.height, responses_to_j(resp)
+            )
         rc = self.app.commit()
         if rc is not None and getattr(rc, "retain_height", 0) > 0 and \
                 self.on_retain_height is not None:

@@ -15,7 +15,44 @@ import sqlite3
 import threading
 from typing import List, Optional
 
-from cometbft_tpu.libs.pubsub import Query
+from cometbft_tpu.libs.pubsub import CMP, RANGE_OPS, Query
+
+
+def _match_cond(db, table: str, col: str, c) -> set:
+    """Rows of `table` matching one Condition; returns the set of `col`.
+
+    Range comparisons (state/txindex/kv/kv.go:420 matchRange) fetch the
+    key's rows and compare numerically host-side — sqlite's CAST turns
+    garbage into 0.0, which would phantom-match."""
+    if c.op == "=":
+        cur = db.execute(
+            f"SELECT {col} FROM {table} WHERE key=? AND value=?",
+            (c.key, c.value),
+        )
+    elif c.op == "CONTAINS":
+        cur = db.execute(
+            f"SELECT {col} FROM {table} WHERE key=? AND value LIKE ?",
+            (c.key, f"%{c.value}%"),
+        )
+    elif c.op in RANGE_OPS:
+        want = float(c.value)
+        cmp = CMP[c.op]
+        cur = db.execute(
+            f"SELECT {col}, value FROM {table} WHERE key=?", (c.key,)
+        )
+        out = set()
+        for row in cur.fetchall():
+            try:
+                if cmp(float(row[1]), want):
+                    out.add(row[0])
+            except (TypeError, ValueError):
+                pass
+        return out
+    else:  # EXISTS
+        cur = db.execute(
+            f"SELECT {col} FROM {table} WHERE key=?", (c.key,)
+        )
+    return {r[0] for r in cur.fetchall()}
 
 
 class TxIndexer:
@@ -77,39 +114,53 @@ class TxIndexer:
                 "log": row[5]}
 
     def search(self, query: str, limit: int = 100) -> List[dict]:
-        with self._lock:
-            return self._search_locked(query, limit)
+        return self.search_paged(query, page=1, per_page=limit)[1]
 
-    def _search_locked(self, query: str, limit: int = 100) -> List[dict]:
-        """AND-joined event conditions -> matching txs, height order."""
+    def search_paged(self, query: str, page: int = 1, per_page: int = 30,
+                     order: str = "asc"):
+        """Paginated search -> (total_count, page items).
+
+        Only (hash, height, index) tuples are materialized for the full
+        match set; complete rows are loaded for the requested page only
+        (rpc/core/tx.go TxSearch page/per_page/order_by)."""
+        with self._lock:
+            return self._search_locked(query, page, per_page, order)
+
+    def _search_locked(self, query: str, page: int, per_page: int,
+                       order: str):
+        """AND-joined event conditions -> matching txs."""
         q = Query(query)
         hashes: Optional[set] = None
         for c in q.conditions:
-            if c.op == "=":
-                cur = self._db.execute(
-                    "SELECT hash FROM tx_events WHERE key=? AND value=?",
-                    (c.key, c.value),
-                )
-            elif c.op == "CONTAINS":
-                cur = self._db.execute(
-                    "SELECT hash FROM tx_events WHERE key=? AND "
-                    "value LIKE ?", (c.key, f"%{c.value}%"),
-                )
-            else:  # EXISTS
-                cur = self._db.execute(
-                    "SELECT hash FROM tx_events WHERE key=?", (c.key,)
-                )
-            found = {r[0] for r in cur.fetchall()}
+            found = _match_cond(self._db, "tx_events", "hash", c)
             hashes = found if hashes is None else hashes & found
+        # deterministic order over light (height, index, hash) tuples
+        # (batched IN queries, not one SELECT per hash), then hydrate
+        # only the requested page
+        keys = []
+        hl = list(hashes or [])
+        for i in range(0, len(hl), 500):
+            chunk = hl[i:i + 500]
+            cur = self._db.execute(
+                "SELECT hash, height, tx_index FROM txs WHERE hash IN "
+                f"({','.join('?' * len(chunk))})", chunk,
+            )
+            keys += [(r[1], r[2], r[0]) for r in cur.fetchall()]
+        keys.sort(reverse=(order == "desc"))
+        total = len(keys)
+        per_page = max(1, min(per_page, 100))
+        total_pages = max(1, -(-total // per_page))
+        if not 1 <= page <= total_pages:
+            raise ValueError(
+                f"page {page} out of range [1, {total_pages}]"
+            )
+        window = keys[(page - 1) * per_page: page * per_page]
         out = []
-        for h in hashes or []:
+        for _, _, h in window:
             item = self._get_locked(h)
             if item:
                 out.append(item)
-        # deterministic order FIRST, then truncate — slicing the raw set
-        # would drop an arbitrary subset
-        out.sort(key=lambda d: (d["height"], d["index"]))
-        return out[:limit]
+        return total, out
 
     def prune(self, retain_height: int) -> int:
         with self._lock, self._db:
@@ -163,22 +214,7 @@ class BlockIndexer:
         q = Query(query)
         heights: Optional[set] = None
         for c in q.conditions:
-            if c.op == "=":
-                cur = self._db.execute(
-                    "SELECT height FROM block_events WHERE key=? AND "
-                    "value=?", (c.key, c.value),
-                )
-            elif c.op == "CONTAINS":
-                cur = self._db.execute(
-                    "SELECT height FROM block_events WHERE key=? AND "
-                    "value LIKE ?", (c.key, f"%{c.value}%"),
-                )
-            else:
-                cur = self._db.execute(
-                    "SELECT height FROM block_events WHERE key=?",
-                    (c.key,),
-                )
-            found = {r[0] for r in cur.fetchall()}
+            found = _match_cond(self._db, "block_events", "height", c)
             heights = found if heights is None else heights & found
         return sorted(heights or [])[:limit]
 

@@ -72,6 +72,9 @@ class Pruner(BaseService):
             if self.evidence_safe_height is not None:
                 vr = min(vr, max(1, self.evidence_safe_height()))
             self.state_store.prune_validators(vr)
+        if self.state_store is not None and \
+                hasattr(self.state_store, "prune_abci_responses"):
+            self.state_store.prune_abci_responses(rh)
         return removed
 
     def _run(self) -> None:

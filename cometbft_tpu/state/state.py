@@ -125,6 +125,14 @@ class StateStore:
                 "CREATE TABLE IF NOT EXISTS validators ("
                 "height INTEGER PRIMARY KEY, vals TEXT)"
             )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS abci_responses ("
+                "height INTEGER PRIMARY KEY, resp TEXT)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS params ("
+                "height INTEGER PRIMARY KEY, p TEXT)"
+            )
 
     def save(self, st: State) -> None:
         doc = {
@@ -152,6 +160,14 @@ class StateStore:
                 (
                     st.last_block_height + 1,
                     json.dumps(_valset_to_j(st.validators)),
+                ),
+            )
+            # consensus-params history (state/store.go ConsensusParamsInfo)
+            self._db.execute(
+                "INSERT OR REPLACE INTO params VALUES (?, ?)",
+                (
+                    st.last_block_height + 1,
+                    json.dumps(st.consensus_params.to_j()),
                 ),
             )
 
@@ -186,6 +202,44 @@ class StateStore:
             )
             row = cur.fetchone()
             return _valset_from_j(json.loads(row[0])) if row else None
+
+    def load_consensus_params(self, height: int):
+        """Params in force at `height` (the newest record <= height —
+        params persist until changed; state/store.go LoadConsensusParams)."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT p FROM params WHERE height<=? "
+                "ORDER BY height DESC LIMIT 1", (height,)
+            )
+            row = cur.fetchone()
+            if row is None:
+                return None
+            return ConsensusParams.from_j(json.loads(row[0]))
+
+    def save_abci_responses(self, height: int, doc: dict) -> None:
+        """Persist a height's FinalizeBlock results for `block_results`
+        and event reindexing (state/store.go SaveFinalizeBlockResponse).
+        `doc` is the JSON form built by execution.responses_to_j."""
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO abci_responses VALUES (?, ?)",
+                (height, json.dumps(doc)),
+            )
+
+    def load_abci_responses(self, height: int) -> Optional[dict]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT resp FROM abci_responses WHERE height=?", (height,)
+            )
+            row = cur.fetchone()
+            return json.loads(row[0]) if row else None
+
+    def prune_abci_responses(self, retain_height: int) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "DELETE FROM abci_responses WHERE height < ?",
+                (retain_height,),
+            )
 
     def prune_validators(self, retain_height: int) -> None:
         """Drop validator-set history below retain_height (the pruner's

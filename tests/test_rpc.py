@@ -113,6 +113,113 @@ def test_rpc_core_routes(rpc_node):
         c.block(10_000)
 
 
+def test_query_range_comparisons():
+    q = Query("tx.height>2 AND tx.height<=5")
+    assert q.matches({"tx.height": ["3"]})
+    assert q.matches({"tx.height": ["5"]})
+    assert not q.matches({"tx.height": ["2"]})
+    assert not q.matches({"tx.height": ["6"]})
+    assert not q.matches({"tx.height": ["zebra"]})
+    with pytest.raises(Exception):
+        Query("tx.height > banana")
+
+
+def test_rpc_route_parity(rpc_node):
+    """The round-3 route-gap list (VERDICT): block_results, header,
+    header_by_hash, check_tx, consensus_params, consensus_state,
+    dump_consensus_state, genesis_chunked, pagination."""
+    node, url = rpc_node
+    c = HTTPClient(url)
+
+    res = c.broadcast_tx_commit(b"pk=pv")
+    h = res["height"]
+
+    hd = c.call("header", height=h)
+    assert hd["header"]["height"] == h
+    b = c.block(h)
+    hbh = c.call("header_by_hash", hash=b["block_id"]["hash"])
+    assert hbh["header"]["height"] == h
+
+    br = c.call("block_results", height=h)
+    assert br["height"] == h
+    assert any(r["code"] == 0 for r in br["txs_results"])
+    assert br["app_hash"]
+
+    cp = c.call("consensus_params")
+    assert "block" in cp["consensus_params"]
+
+    cs = c.call("consensus_state")
+    assert cs["round_state"]["height"] >= h
+    dcs = c.call("dump_consensus_state")
+    assert "peers" in dcs and dcs["round_state"]["height"] >= h
+
+    gc = c.call("genesis_chunked")
+    doc = json.loads(base64.b64decode(gc["data"]))
+    assert doc["chain_id"] == "rpc-chain" and gc["total"] >= 1
+
+    ct = c.call("check_tx", tx=base64.b64encode(b"x=y").decode())
+    assert ct["code"] == 0
+    # check_tx must NOT add to the mempool
+    assert c.call("num_unconfirmed_txs")["n_txs"] == 0
+
+    # validators pagination
+    v = c.call("validators", height=h, page=1, per_page=1)
+    assert v["count"] == 1 and v["total"] == 1
+    with pytest.raises(Exception):
+        c.call("validators", height=h, page=99)
+
+    # tx_search pagination + order
+    for i in range(3):
+        c.broadcast_tx_commit(b"m%d=v" % i)
+    ts = c.call("tx_search", query="tx.height EXISTS", per_page=2,
+                page=1, order_by="desc")
+    assert ts["total_count"] >= 4 and len(ts["txs"]) == 2
+    hs = [t["height"] for t in ts["txs"]]
+    assert hs == sorted(hs, reverse=True)
+    # range query through the indexer
+    ts2 = c.call("tx_search", query=f"tx.height>={h}")
+    assert ts2["total_count"] >= 1
+    ts3 = c.call("tx_search", query="tx.height<1")
+    assert ts3["total_count"] == 0
+
+
+def test_tx_prove_and_verified_abci_query(rpc_node):
+    """tx(prove=true) returns a valid inclusion proof; abci_query with
+    prove returns a kv proof chaining to the app hash."""
+    from cometbft_tpu.crypto.proof_ops import (
+        ProofError,
+        ProofOp,
+        default_runtime,
+    )
+    from cometbft_tpu.types.tx import TxProof
+
+    node, url = rpc_node
+    c = HTTPClient(url)
+    res = c.broadcast_tx_commit(b"proofme=42")
+    h, txhash = res["height"], res["hash"]
+
+    t = c.call("tx", hash=txhash, prove=True)
+    tp = TxProof.from_j(t["proof"])
+    blk = node.block_store.load_block(h)
+    assert tp.validate(blk.header.data_hash)
+    assert tp.data == b"proofme=42"
+    # tampered proof fails
+    bad = TxProof.from_j(t["proof"])
+    bad.data = b"proofme=43"
+    assert not bad.validate(blk.header.data_hash)
+
+    q = c.call("abci_query", data=b"proofme".hex(), prove=True)
+    resp = q["response"]
+    ops = [ProofOp.from_j(o) for o in resp["proof_ops"]["ops"]]
+    # the proof chains to the app hash in the NEXT height's header
+    assert node.consensus.wait_for_height(resp["height"] + 1, timeout=60)
+    hdr = node.block_store.load_block(resp["height"] + 1).header
+    rt = default_runtime()
+    rt.verify_value(ops, hdr.app_hash, b"proofme", b"42")
+    with pytest.raises(ProofError):
+        rt.verify_value(ops, hdr.app_hash, b"proofme", b"43")
+
+
 def test_light_client_syncs_over_rpc(rpc_node):
     node, url = rpc_node
     from cometbft_tpu.light import client as lc

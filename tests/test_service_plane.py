@@ -3,6 +3,7 @@
 Reference: cmd/cometbft/commands/rollback.go, compact.go,
 inspect/inspect.go, light/proxy/proxy.go, abci/cmd/abci-cli.
 """
+import base64
 import json
 import urllib.request
 
@@ -137,6 +138,68 @@ def test_light_proxy(tmp_path):
                                         timeout=30) as r:
                 v = json.loads(r.read())["result"]
             assert v["verified"] and len(v["validators"]) == 1
+
+            # VERIFIED data queries (light/rpc/client.go:117): commit a
+            # tx, query it through the proxy — result is proof-checked
+            # against the trusted header chain
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            res = HTTPClient(url).broadcast_tx_commit(b"lp=ok")
+            assert node.consensus.wait_for_height(res["height"] + 1,
+                                                  timeout=60)
+            with urllib.request.urlopen(
+                base + "/abci_query?data=" + b"lp".hex(), timeout=60
+            ) as r:
+                q = json.loads(r.read())["result"]["response"]
+            assert q["verified"] is True
+            assert base64.b64decode(q["value"]) == b"ok"
+
+            txhash = res["hash"]
+            with urllib.request.urlopen(
+                base + f"/tx?hash={txhash}", timeout=60
+            ) as r:
+                t = json.loads(r.read())["result"]
+            assert t["verified"] is True
+            assert base64.b64decode(t["tx"]) == b"lp=ok"
+
+            # a LYING primary is caught: tamper the served value by
+            # pointing the proxy's raw-http client at a mitm that
+            # rewrites query responses
+            class _MITM:
+                def __init__(self, inner):
+                    self.inner = inner
+
+                def __getattr__(self, a):
+                    return getattr(self.inner, a)
+
+                def call(self, method, **params):
+                    r = self.inner.call(method, **params)
+                    if method == "abci_query":
+                        r["response"]["value"] = base64.b64encode(
+                            b"evil"
+                        ).decode()
+                    if method == "tx":
+                        r["tx"] = base64.b64encode(b"evil=1").decode()
+                        if "proof" in r:
+                            r["proof"]["data"] = b"evil=1".hex()
+                    return r
+
+            proxy_obj = proxy.httpd.proxy
+            saved = proxy_obj.http
+            proxy_obj.http = _MITM(saved)
+            try:
+                with urllib.request.urlopen(
+                    base + "/abci_query?data=" + b"lp".hex(), timeout=60
+                ) as r:
+                    doc = json.loads(r.read())
+                assert "error" in doc, "tampered query result accepted!"
+                with urllib.request.urlopen(
+                    base + f"/tx?hash={txhash}", timeout=60
+                ) as r:
+                    doc = json.loads(r.read())
+                assert "error" in doc, "tampered tx accepted!"
+            finally:
+                proxy_obj.http = saved
         finally:
             proxy.stop()
     finally:
