@@ -3,18 +3,21 @@
 Reference: consensus/reactor.go — channels State/Data/Vote 0x20-0x22
 (:28-31), Receive demux (:241), per-peer gossip routines (:569,:737),
 NewRoundStep announcements (:404 broadcastNewRoundStepMessage) and
-PeerState height/round/step tracking (peer_state.go).
+PeerState height/round/step + vote bitarray tracking (peer_state.go).
 
-Design vs the reference: votes/proposals still flood (with dedup), but
-only AFTER synchronous signature verification against the current
-validator set — an invalid message punishes the sending peer and is
-never relayed (round-2 advisory: pre-verification relay let forged
-payloads flood-amplify network-wide). Catch-up is served from a
-per-peer monitor: every NewRoundStep a peer sends updates its
-PeerState; a peer whose height lags ours gets the decided block +
-seen commit for its height pushed on the DATA channel (the
-gossipDataRoutine catch-up arm, reactor.go:569), so a partitioned
-node that rejoins mid-height can finalize without full blocksync.
+Vote distribution is LACK-BASED, not flooded (reactor.go:737
+gossipVotesRoutine + :404 broadcastHasVote): every added vote triggers
+a tiny HasVote announcement; each peer's PeerState keeps per-(round,
+type) bitarrays of what that peer holds, and the gossip routine sends a
+peer only votes it lacks — so a vote crosses each link ~once, bounding
+traffic at O(votes x links) instead of flood's O(votes x links x
+degree). Periodic VoteSetMaj23 announcements make peers answer with
+VoteSetBits (their bitarray for that majority), healing bitmaps that
+lost HasVote messages and pulling round-lagged peers forward
+(reactor.go:896-960). Messages are verified BEFORE any relay or
+enqueue — a forged vote costs the sender its connection and goes no
+further. Catch-up for height-lagged peers pushes the decided block +
+seen commit (the gossipDataRoutine catch-up arm, reactor.go:569).
 """
 from __future__ import annotations
 
@@ -45,7 +48,8 @@ MAX_BLOCK_PARTS = 1024   # 64 MiB of wire form; >> any sane max_bytes
 
 
 class PeerState:
-    """Last-known consensus position of one peer (peer_state.go)."""
+    """Last-known consensus position of one peer (peer_state.go),
+    including per-(round, type) bitarrays of the votes it holds."""
 
     def __init__(self):
         self.height = 0
@@ -54,17 +58,55 @@ class PeerState:
         self.last_update = 0.0
         self.last_pushed_height = 0   # catch-up dedup
         self.last_push_time = 0.0
+        # (round, vote_type) -> BitArray of held votes, current height
+        self._has: dict = {}
+
+    def reset_votes(self) -> None:
+        self._has.clear()
+
+    def has_bits(self, round_: int, vtype: int, n: int):
+        from cometbft_tpu.libs.bits import BitArray
+
+        ba = self._has.get((round_, vtype))
+        if ba is None or ba.bits != n:
+            ba = BitArray(n)
+            self._has[(round_, vtype)] = ba
+        return ba
+
+    def mark_vote(self, round_: int, vtype: int, index: int,
+                  n: int) -> None:
+        # bound rogue-round dict growth, but never refuse an EXISTING
+        # key — a full dict that stopped marking would make gossip
+        # re-send the same votes every tick forever
+        if 0 <= index < n and ((round_, vtype) in self._has
+                               or len(self._has) < 64):
+            self.has_bits(round_, vtype, n).set_index(index, True)
 
 
 class ConsensusReactor(Reactor):
-    def __init__(self, cs: ConsensusState, catchup_interval: float = 0.25):
+    def __init__(self, cs: ConsensusState, catchup_interval: float = 0.25,
+                 gossip_interval: float = 0.02):
         super().__init__("CONSENSUS")
         self.cs = cs
         cs.broadcast = self._broadcast_own
         cs.on_step_change = self._announce_step
+        cs.on_vote_added = self._on_vote_added
         self._seen_votes = set()
         self._seen_proposals = set()
         self._peer_states = {}  # peer -> PeerState
+        self._gossip_interval = gossip_interval
+        self._maj23_every = max(1, int(1.0 / max(gossip_interval, 1e-3)))
+        self._gossip_tick = 0
+        # observability: duplicate-delivery accounting (tests assert the
+        # lack-based gossip bounds redundant traffic)
+        self.votes_received = 0
+        self.votes_duplicate = 0
+        self.votes_sent = 0
+        # (height, round, type, index) -> first-seen time: fresh votes
+        # are NOT gossiped for a grace period — the origin's direct
+        # broadcast + the HasVote announcements are in flight, and
+        # gossiping before they land triple-delivers every vote
+        self._vote_first_seen = {}
         # part reassembly (state.go ProposalBlockParts analog, kept
         # reactor-side so the state machine stays whole-block):
         # (height, round) -> {"prop": Proposal, "ps": PartSet}
@@ -111,7 +153,23 @@ class ConsensusReactor(Reactor):
         if self.switch is None:
             return
         if kind == "vote":
-            self.switch.broadcast(VOTE_CHANNEL, _vote_bytes(payload))
+            # own votes go straight to every peer (latency matters for
+            # liveness); the per-peer bitarrays are marked optimistically
+            # so the gossip routine doesn't resend them
+            vote = payload
+            n = len(self.cs.state.validators)
+            with self._lock:
+                peers = list(self._peer_states.items())
+            data = _vote_bytes(vote)
+            for peer, ps in peers:
+                ok = peer.send(VOTE_CHANNEL, data)
+                self.votes_sent += 1
+                # mark ONLY delivered sends (reference SetHasVote runs
+                # only when Send succeeds) — a false "has it" bit would
+                # withhold the vote from that peer forever
+                if ok is not False and ps.height == vote.height:
+                    ps.mark_vote(vote.round, vote.vote_type,
+                                 vote.validator_index, n)
         elif kind == "proposal":
             # proposal metadata first, then every part — the block never
             # rides whole (reactor.go:569 gossipDataRoutine; parts allow
@@ -144,17 +202,54 @@ class ConsensusReactor(Reactor):
         if self.switch is not None:
             self.switch.broadcast(STATE_CHANNEL, self._step_bytes())
 
+    GOSSIP_GRACE = 0.3  # seconds before a fresh vote becomes gossipable
+
+    def _on_vote_added(self, vote) -> None:
+        """broadcastHasVote (reactor.go:404): a tiny announcement that we
+        hold vote (h, r, type, index) — peers mark their picture of us
+        and stop queueing that vote for us."""
+        with self._lock:
+            fs = self._vote_first_seen
+            fs.setdefault(
+                (vote.height, vote.round, vote.vote_type,
+                 vote.validator_index), time.time(),
+            )
+            if len(fs) > 4096:
+                h = self.cs.height
+                for k in [k for k in fs if k[0] < h]:
+                    del fs[k]
+        if self.switch is None:
+            return
+        self.switch.broadcast(STATE_CHANNEL, json.dumps({
+            "t": "has_vote", "h": vote.height, "r": vote.round,
+            "vt": vote.vote_type, "i": vote.validator_index,
+        }).encode())
+
     # -- catch-up (gossipDataRoutine's lagging-peer arm) -------------------
 
+    GOSSIP_BATCH = 8  # votes per peer per tick
+
     def _catchup_routine(self) -> None:
+        """The per-peer gossip pump: lack-based vote sends every tick,
+        catch-up pushes and maj23 announcements at a slower cadence
+        (reactor.go gossipVotesRoutine + queryMaj23Routine folded into
+        one thread — per-peer goroutines don't pay on a 1-core host)."""
+        last_catchup = 0.0
         while not self._stop.is_set():
-            time.sleep(self._catchup_interval)
+            time.sleep(self._gossip_interval)
             if self.switch is None:
                 continue
+            self._gossip_votes()
+            self._gossip_tick += 1
+            if self._gossip_tick % self._maj23_every == 0:
+                self._announce_maj23()
+            now = time.time()
+            if now - last_catchup < self._catchup_interval:
+                continue
+            last_catchup = now
             with self._lock:
                 peers = list(self._peer_states.items())
             our_h = self.cs.height
-            now = time.time()
             for peer, ps in peers:
                 if not 0 < ps.height < our_h:
                     continue
@@ -167,6 +262,84 @@ class ConsensusReactor(Reactor):
                 ps.last_pushed_height = ps.height
                 ps.last_push_time = now
                 self._send_catchup(peer, ps.height)
+
+    def _vote_sets(self, round_: int):
+        from cometbft_tpu.types import canonical
+
+        votes = self.cs.votes
+        return ((canonical.PREVOTE_TYPE, votes.prevotes(round_)),
+                (canonical.PRECOMMIT_TYPE, votes.precommits(round_)))
+
+    def _gossip_votes(self) -> None:
+        """Send each same-height peer up to GOSSIP_BATCH votes it lacks
+        (reactor.go:737 gossipVotesRoutine's pickSendVote, bitarray
+        difference + random pick)."""
+        cs = self.cs
+        h, our_round = cs.height, cs.round
+        n = len(cs.state.validators)
+        if n == 0:
+            return
+        with self._lock:
+            peers = list(self._peer_states.items())
+        import random
+
+        now = time.time()
+        with self._lock:
+            fs = dict(self._vote_first_seen)
+        for peer, ps in peers:
+            if ps.height != h:
+                continue
+            budget = self.GOSSIP_BATCH
+            for r in range(our_round, -1, -1):
+                if budget <= 0:
+                    break
+                for vtype, vs in self._vote_sets(r):
+                    if vs is None or budget <= 0:
+                        continue
+                    ours = vs.bit_array()
+                    if ours.is_empty():
+                        continue
+                    # bitmap reads/writes under the reactor lock — the
+                    # receive path mutates the same PeerState dicts
+                    with self._lock:
+                        lacking = ours.sub(ps.has_bits(r, vtype, n))
+                    idxs = lacking.true_indices()
+                    if not idxs:
+                        continue
+                    random.shuffle(idxs)
+                    for idx in idxs:
+                        if budget <= 0:
+                            break
+                        seen = fs.get((h, r, vtype, idx))
+                        if seen is not None and \
+                                now - seen < self.GOSSIP_GRACE:
+                            continue  # direct send/HasVote still in flight
+                        vote = vs.get_by_index(idx)
+                        if vote is None:
+                            continue
+                        ok = peer.send(VOTE_CHANNEL, _vote_bytes(vote))
+                        self.votes_sent += 1
+                        if ok is not False:
+                            with self._lock:
+                                ps.mark_vote(r, vtype, idx, n)
+                        budget -= 1
+
+    def _announce_maj23(self) -> None:
+        """Broadcast VoteSetMaj23 for any 2/3 majority we have seen;
+        receivers answer with VoteSetBits (reactor.go:896
+        queryMaj23Routine)."""
+        cs = self.cs
+        h, r = cs.height, cs.round
+        for vtype, vs in self._vote_sets(r):
+            if vs is None:
+                continue
+            maj = vs.two_thirds_majority()
+            if maj is None:
+                continue
+            self.switch.broadcast(STATE_CHANNEL, json.dumps({
+                "t": "maj23", "h": h, "r": r, "vt": vtype,
+                "bid": serde.bid_to_j(maj),
+            }).encode())
 
     def _send_catchup(self, peer: Peer, height: int) -> None:
         """Push the decided block + its seen commit for the peer's height
@@ -206,31 +379,99 @@ class ConsensusReactor(Reactor):
 
     def _receive_step(self, peer: Peer, msg: bytes) -> None:
         j = json.loads(msg.decode())
-        if j.get("t") != "step":
-            raise ValueError("bad state-channel message")
+        t = j.get("t")
+        if t == "step":
+            with self._lock:
+                ps = self._peer_states.setdefault(peer, PeerState())
+                new_h = int(j["h"])
+                if new_h != ps.height:
+                    ps.reset_votes()  # bitarrays are per-height
+                ps.height = new_h
+                ps.round = int(j["r"])
+                ps.step = int(j["s"])
+                ps.last_update = time.time()
+            return
+        if t == "has_vote":
+            # peer announces it holds one vote (reactor.go HasVote)
+            if int(j["h"]) != self.cs.height:
+                return
+            r = int(j["r"])
+            if not 0 <= r <= self.cs.round + MAX_ROUND_AHEAD:
+                return  # rogue rounds must not grow the bitmap dict
+            n = len(self.cs.state.validators)
+            with self._lock:
+                ps = self._peer_states.setdefault(peer, PeerState())
+                ps.mark_vote(r, int(j["vt"]), int(j["i"]), n)
+            return
+        if t == "maj23":
+            self._receive_maj23(peer, j)
+            return
+        if t == "vsb":
+            self._receive_vsb(peer, j)
+            return
+        raise ValueError("bad state-channel message")
+
+    def _receive_maj23(self, peer: Peer, j: dict) -> None:
+        """Peer saw a 2/3 majority: record it and answer with OUR
+        bitarray for that (h, r, type, blockID) so the peer learns what
+        we lack (reactor.go:241 VoteSetMaj23 arm -> VoteSetBits)."""
+        cs = self.cs
+        h, r, vt = int(j["h"]), int(j["r"]), int(j["vt"])
+        if h != cs.height or not 0 <= r <= cs.round + MAX_ROUND_AHEAD:
+            return
+        bid = serde.bid_from_j(j["bid"])
+        vs = dict(self._vote_sets(r)).get(vt)
+        if vs is None:
+            return
+        try:
+            vs.set_peer_maj23(getattr(peer, "peer_id", str(id(peer))), bid)
+        except Exception as e:  # noqa: BLE001 - conflicting maj23 claims
+            _log.debug("peer maj23 rejected: %s", e)
+        ours = vs.bit_array_by_block_id(bid) or vs.bit_array()
+        peer.send(STATE_CHANNEL, json.dumps({
+            "t": "vsb", "h": h, "r": r, "vt": vt,
+            "bits": _bits_hex(ours),
+        }).encode())
+
+    def _receive_vsb(self, peer: Peer, j: dict) -> None:
+        """VoteSetBits: the peer's holdings for one (h, r, type) — OR
+        into its PeerState so the gossip routine fills its gaps."""
+        cs = self.cs
+        h, r, vt = int(j["h"]), int(j["r"]), int(j["vt"])
+        if h != cs.height:
+            return
+        n = len(cs.state.validators)
+        bits = _bits_from_hex(j.get("bits", ""), n)
         with self._lock:
             ps = self._peer_states.setdefault(peer, PeerState())
-            ps.height = int(j["h"])
-            ps.round = int(j["r"])
-            ps.step = int(j["s"])
-            ps.last_update = time.time()
+            for i in bits:
+                ps.mark_vote(r, vt, i, n)
 
     def _receive_vote(self, peer: Peer, msg: bytes) -> None:
         vote = serde.vote_from_j(json.loads(msg.decode()))
+        cs = self.cs
+        n = len(cs.state.validators)
         key = (vote.height, vote.round, vote.vote_type,
                vote.validator_address, vote.signature)
+        self.votes_received += 1
         if key in self._seen_votes:
+            # duplicate delivery: mark the sender as holding it (it
+            # clearly does) — no relay, no re-verify
+            self.votes_duplicate += 1
+            with self._lock:
+                ps = self._peer_states.setdefault(peer, PeerState())
+                if vote.height == cs.height:
+                    ps.mark_vote(vote.round, vote.vote_type,
+                                 vote.validator_index, n)
             return
-        cs = self.cs
         if vote.height != cs.height:
             # stale or future vote: neither verifiable against the current
             # set nor useful to the state machine; catch-up channels (the
             # commit push above / blocksync) cover lagging nodes. Not a
             # punishable offence — honest peers race height transitions.
             return
-        # synchronous verification BEFORE relay or enqueue: a forged vote
-        # must cost the sender its connection and go no further (round-2
-        # advisory on pre-validation flood amplification)
+        # synchronous verification BEFORE enqueue: a forged vote must
+        # cost the sender its connection and go no further
         val = cs.state.validators.get_by_index(vote.validator_index)
         if val is None or val.address != vote.validator_address:
             # benign race: the consensus thread may have advanced the
@@ -247,9 +488,14 @@ class ConsensusReactor(Reactor):
         self._seen_votes.add(key)
         if len(self._seen_votes) > 50000:
             self._seen_votes.clear()
+        with self._lock:
+            ps = self._peer_states.setdefault(peer, PeerState())
+            ps.mark_vote(vote.round, vote.vote_type,
+                         vote.validator_index, n)
         cs.receive_vote(vote)
-        # relay so votes reach non-neighbors (flood w/ dedup)
-        self.switch.broadcast(VOTE_CHANNEL, msg)
+        # NO flood relay: on_vote_added broadcasts a HasVote and the
+        # lack-based gossip routine delivers the vote itself only to
+        # peers that still lack it (reactor.go:737)
 
     def _receive_data(self, peer: Peer, msg: bytes) -> None:
         j = json.loads(msg.decode())
@@ -409,6 +655,27 @@ class ConsensusReactor(Reactor):
 
 class _PeerMisbehavior(Exception):
     pass
+
+
+def _bits_hex(ba) -> str:
+    """BitArray -> hex (LSB-first bytes) for the VoteSetBits wire."""
+    out = bytearray((ba.bits + 7) // 8)
+    for i in range(ba.bits):
+        if ba.get_index(i):
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out).hex()
+
+
+def _bits_from_hex(s: str, n: int):
+    """hex -> indices of set bits, bounded to n."""
+    try:
+        raw = bytes.fromhex(s)
+    except ValueError:
+        return []
+    return [
+        i for i in range(min(n, len(raw) * 8))
+        if raw[i // 8] >> (i % 8) & 1
+    ]
 
 
 def _vote_bytes(vote) -> bytes:

@@ -123,6 +123,8 @@ class ConsensusState(BaseService):
         # reactor hook: fired on height/round/step changes so peers learn
         # our position (reactor.go:404 broadcastNewRoundStepMessage)
         self.on_step_change: Optional[Callable] = None
+        # fired whenever a vote is ADDED to our sets (HasVote gossip)
+        self.on_vote_added: Optional[Callable] = None
         # evidence wiring (node/node.go:369 evidence pool into consensus):
         # conflicting votes become DuplicateVoteEvidence; on_evidence lets
         # the evidence reactor gossip what we found locally
@@ -699,6 +701,13 @@ class ConsensusState(BaseService):
                          vote.validator_address.hex()[:12], e)
             return
         if added:
+            if self.on_vote_added is not None:
+                try:
+                    # reactor hook: broadcast HasVote so peers stop
+                    # re-sending this vote (reactor.go:404 broadcastHasVote)
+                    self.on_vote_added(vote)
+                except Exception:  # noqa: BLE001 - gossip must not stall
+                    _log.exception("on_vote_added hook failed")
             self._check_vote_quorums(vote.round)
 
     def _submit_equivocation(self, e: ConflictingVoteError) -> None:

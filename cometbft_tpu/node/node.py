@@ -112,7 +112,16 @@ class Node(BaseService):
             self.state_store.save(state)
         else:
             # replay stored blocks the app hasn't seen
-            # (consensus/replay.go:285 ReplayBlocks)
+            # (consensus/replay.go:285 ReplayBlocks). The request must be
+            # BIT-IDENTICAL to the live apply_block's: decided_last_commit
+            # and misbehavior included — an app that hashes CommitInfo
+            # (fee distribution, slashing) would otherwise compute a
+            # different state on replay than it did live.
+            from cometbft_tpu.state.execution import (
+                build_last_commit_info,
+                build_misbehavior,
+            )
+
             info = self.app.info(abci.RequestInfo())
             for h in range(
                 info.last_block_height + 1, state.last_block_height + 1
@@ -120,10 +129,15 @@ class Node(BaseService):
                 blk = self.block_store.load_block(h)
                 if blk is None:
                     raise RuntimeError(f"missing block {h} for app replay")
+                last_vals = self.state_store.load_validators(h - 1)
                 self.app.finalize_block(abci.RequestFinalizeBlock(
                     txs=list(blk.data.txs), hash=blk.hash() or b"",
                     height=h, proposer_address=blk.header.proposer_address,
                     time_seconds=blk.header.time.seconds,
+                    decided_last_commit=build_last_commit_info(
+                        blk.last_commit, last_vals, h
+                    ),
+                    misbehavior=build_misbehavior(blk),
                 ))
                 self.app.commit()
 

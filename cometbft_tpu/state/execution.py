@@ -28,6 +28,50 @@ class ExecutionError(Exception):
     pass
 
 
+def build_last_commit_info(last_commit, last_validators, height: int):
+    """execution.go:443 buildLastCommitInfo, shared by the live apply
+    path and handshake replay — the app MUST see identical CommitInfo on
+    both or replay diverges (consensus/replay.go:285's bug class)."""
+    if last_commit is None or not last_commit.signatures or \
+            last_validators is None:
+        return None
+    if len(last_commit.signatures) != len(last_validators):
+        # commit rows and the validator set they signed for must be
+        # 1:1; a mismatch means store/valset corruption, and feeding
+        # the app zero-power rows would silently corrupt incentive
+        # logic (execution.go:449 panics here too)
+        raise ExecutionError(
+            f"commit has {len(last_commit.signatures)} signatures but "
+            f"last_validators has {len(last_validators)} validators "
+            f"(height {height})"
+        )
+    votes = []
+    for i, cs in enumerate(last_commit.signatures):
+        val = last_validators.validators[i]
+        votes.append(abci.VoteInfo(
+            validator_address=val.address,
+            power=val.voting_power,
+            block_id_flag=cs.flag,
+        ))
+    return abci.CommitInfo(round=last_commit.round, votes=votes)
+
+
+def build_misbehavior(block) -> list:
+    """Evidence -> abci.Misbehavior (execution.go extended info)."""
+    out = []
+    for ev in block.evidence:
+        is_dup = hasattr(ev, "vote_a")
+        addr = (ev.vote_a.validator_address if is_dup else b"")
+        out.append(abci.Misbehavior(
+            type="duplicate_vote" if is_dup else "light_client_attack",
+            validator_address=addr,
+            height=ev.height,
+            time_seconds=ev.timestamp.seconds,
+            total_voting_power=ev.total_voting_power,
+        ))
+    return out
+
+
 def responses_to_j(resp: abci.ResponseFinalizeBlock) -> dict:
     """JSON form of a FinalizeBlock response for the state store
     (block_results RPC + reindexing read this back)."""
@@ -163,44 +207,13 @@ class BlockExecutor:
     def _build_last_commit_info(self, state: State, block: Block):
         """execution.go:443 buildLastCommitInfo: who signed LastCommit,
         with flags + power, for the app's incentive logic."""
-        lc = block.last_commit
-        if lc is None or not lc.signatures or \
-                state.last_validators is None:
-            return None
-        if len(lc.signatures) != len(state.last_validators):
-            # commit rows and the validator set they signed for must be
-            # 1:1; a mismatch means store/valset corruption, and feeding
-            # the app zero-power rows would silently corrupt incentive
-            # logic (execution.go:449 panics here too)
-            raise ExecutionError(
-                f"commit has {len(lc.signatures)} signatures but "
-                f"last_validators has {len(state.last_validators)} "
-                f"validators (height {block.header.height})"
-            )
-        votes = []
-        for i, cs in enumerate(lc.signatures):
-            val = state.last_validators.validators[i]
-            votes.append(abci.VoteInfo(
-                validator_address=val.address,
-                power=val.voting_power,
-                block_id_flag=cs.flag,
-            ))
-        return abci.CommitInfo(round=lc.round, votes=votes)
+        return build_last_commit_info(
+            block.last_commit, state.last_validators,
+            block.header.height,
+        )
 
     def _build_misbehavior(self, block: Block):
-        """Evidence -> abci.Misbehavior (execution.go extended info)."""
-        out = []
-        for ev in block.evidence:
-            is_dup = hasattr(ev, "vote_a")
-            addr = (ev.vote_a.validator_address if is_dup else b"")
-            out.append(abci.Misbehavior(
-                type="duplicate_vote" if is_dup else "light_client_attack",
-                validator_address=addr,
-                height=ev.height,
-                time_seconds=ev.timestamp.seconds,
-                total_voting_power=ev.total_voting_power,
-            ))
-        return out
+        return build_misbehavior(block)
 
     # -- vote extensions (execution.go:318 ExtendVote, :349 Verify) ---------
 

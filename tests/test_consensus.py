@@ -112,6 +112,71 @@ def test_wal_crash_replay(tmp_path):
         node2.stop()
 
 
+class CommitInfoApp(KVStoreApplication):
+    """An app whose state depends on FinalizeBlock's CommitInfo +
+    misbehavior — the class of app that exposes replay divergence
+    (fee distribution / slashing logic; consensus/replay.go:285)."""
+
+    def finalize_block(self, req):
+        import hashlib
+        import json as _json
+
+        resp = super().finalize_block(req)
+        dlc = req.decided_last_commit
+        blob = _json.dumps({
+            "votes": [
+                (v.validator_address.hex(), v.power, v.block_id_flag)
+                for v in dlc.votes
+            ] if dlc else None,
+            "round": dlc.round if dlc else -1,
+            "misbehavior": [
+                (m.type, m.validator_address.hex(), m.height)
+                for m in (req.misbehavior or [])
+            ],
+        }, sort_keys=True).encode()
+        self.staged[b"ci:%08d" % req.height] = \
+            hashlib.sha256(blob).hexdigest()[:16].encode()
+        self._pending_hash = self._computed_staged_hash(req.height)
+        resp.app_hash = self._pending_hash
+        return resp
+
+
+def test_replay_feeds_identical_commit_info(tmp_path):
+    """Crash + handshake replay must hand the app the SAME
+    decided_last_commit/misbehavior the live path did: an app that
+    hashes CommitInfo reaches an identical app hash after replay
+    (consensus/replay.go:285-360; round-3 weak item 6)."""
+    state, privs = make_genesis(1)
+    home = str(tmp_path / "n0")
+    app = CommitInfoApp()
+    node = Node(app, state, privval=FilePV(privs[0]), home=home,
+                timeouts=FAST)
+    node.start()
+    assert node.consensus.wait_for_height(4, timeout=30)
+    node.broadcast_tx(b"ci=live")
+    assert node.consensus.wait_for_height(node.height() + 2, timeout=30)
+    crash_height = node.height()
+    live_hash = app.app_hash
+    live_state_hash = node.consensus.state.app_hash
+    node.stop()
+
+    # fresh app: handshake replays every stored block into it
+    app2 = CommitInfoApp()
+    node2 = Node(app2, state, privval=FilePV(privs[0]), home=home,
+                 timeouts=FAST)
+    assert app2.height >= crash_height
+    assert app2.app_hash == live_hash, \
+        "replay diverged: app saw different CommitInfo than live"
+    assert node2.consensus.state.app_hash == live_state_hash
+    node2.start()
+    try:
+        # and the chain keeps committing on the replayed state
+        assert node2.consensus.wait_for_height(crash_height + 2,
+                                               timeout=30)
+    finally:
+        node2.stop()
+
+
 @pytest.mark.slow
 def test_hundred_blocks(tmp_path):
     """VERDICT item 6 acceptance: 100 blocks through ABCI, persisted."""
