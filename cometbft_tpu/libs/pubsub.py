@@ -36,7 +36,15 @@ CMP = {
 }
 
 
-def _num(s) -> Optional[float]:
+def _num(s):
+    # ints first, exactly: int64 heights/amounts above 2^53 lose
+    # precision as floats and would phantom-match neighbors (the
+    # reference compares int64s exactly, query/query.go). Python
+    # compares int-vs-float exactly too, so mixed conditions stay safe.
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        pass
     try:
         return float(s)
     except (TypeError, ValueError):

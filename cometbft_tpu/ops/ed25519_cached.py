@@ -218,9 +218,14 @@ _TABLE_LOCK = threading.Lock()
 
 
 def table_for_pubs(pub_bytes: Sequence[bytes]) -> ValsetTable:
-    key = hashlib.sha256(b"".join(pub_bytes)).digest() + len(
-        pub_bytes
-    ).to_bytes(4, "big")
+    h = hashlib.sha256()
+    for p in pub_bytes:
+        # length-prefix each key so the digest is injective over the
+        # list (bare concat collides when key lengths vary, mapping a
+        # signature to the wrong slot's table entries)
+        h.update(len(p).to_bytes(8, "big"))
+        h.update(p)
+    key = h.digest() + len(pub_bytes).to_bytes(4, "big")
     with _TABLE_LOCK:
         t = _TABLE_CACHE.get(key)
         if t is not None:

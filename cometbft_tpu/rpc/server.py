@@ -317,7 +317,10 @@ class Routes:
         from cometbft_tpu.abci import types as abci
 
         raw = self._decode_tx(tx)
-        resp = self.node.app_conns.query.check_tx(
+        # route through the mempool connection (rpc/core/mempool.go uses
+        # mempool.CheckTx): stateful apps keep check-state there, so the
+        # query conn would answer from stale sequence state
+        resp = self.node.app_conns.mempool.check_tx(
             abci.RequestCheckTx(tx=raw)
         )
         return {"code": resp.code, "log": resp.log,
@@ -454,6 +457,12 @@ class Routes:
         )
         if order_by == "desc":
             heights = list(reversed(heights))
+        # drop heights whose blocks have been pruned BEFORE paginating,
+        # so total_count matches what's retrievable and pages don't come
+        # back silently short
+        bs = self.node.block_store
+        lo, hi = bs.base(), bs.height()
+        heights = [h for h in heights if lo <= h <= hi]
         total = len(heights)
         window = self._paginate(heights, page, per_page)
         blocks = []

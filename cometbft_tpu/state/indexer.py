@@ -15,7 +15,7 @@ import sqlite3
 import threading
 from typing import List, Optional
 
-from cometbft_tpu.libs.pubsub import CMP, RANGE_OPS, Query
+from cometbft_tpu.libs.pubsub import CMP, RANGE_OPS, Query, _num
 
 
 def _match_cond(db, table: str, col: str, c) -> set:
@@ -35,18 +35,19 @@ def _match_cond(db, table: str, col: str, c) -> set:
             (c.key, f"%{c.value}%"),
         )
     elif c.op in RANGE_OPS:
-        want = float(c.value)
+        # _num compares ints exactly (int64 heights/amounts above 2^53
+        # lose precision as floats) — same semantics as pubsub.Query so
+        # a subscription and a search over one query string agree
+        want = _num(c.value)
         cmp = CMP[c.op]
         cur = db.execute(
             f"SELECT {col}, value FROM {table} WHERE key=?", (c.key,)
         )
         out = set()
         for row in cur.fetchall():
-            try:
-                if cmp(float(row[1]), want):
-                    out.add(row[0])
-            except (TypeError, ValueError):
-                pass
+            got = _num(row[1])
+            if got is not None and want is not None and cmp(got, want):
+                out.add(row[0])
         return out
     else:  # EXISTS
         cur = db.execute(
