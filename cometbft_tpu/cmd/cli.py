@@ -306,10 +306,29 @@ def cmd_light(args) -> int:
     primary full node + witnesses."""
     from cometbft_tpu.light.proxy import LightProxy
 
-    if not args.trusted_hash and not args.insecure_trust:
+    resumable = False
+    if args.home:
+        # durable trust (light/store/db/db.go): a persisted store with a
+        # non-expired latest block IS a trust root — no TrustOptions
+        # needed on restart
+        db_path = os.path.join(args.home, "light.db")
+        if os.path.exists(db_path):
+            from cometbft_tpu.light.store import DBStore
+            from cometbft_tpu.light.verifier import header_expired
+            from cometbft_tpu.types.timestamp import Timestamp
+
+            st = DBStore(db_path)
+            latest = st.latest()
+            st.close()
+            resumable = latest is not None and not header_expired(
+                latest.signed_header.header, 14 * 24 * 3600.0,
+                Timestamp.now(),
+            )
+    if not args.trusted_hash and not args.insecure_trust and not resumable:
         print("light: refusing to start without --trusted-hash; a "
               "lying primary could pick your trust root. Pass "
-              "--insecure-trust to accept trust-on-first-use (dev only).",
+              "--insecure-trust to accept trust-on-first-use (dev only), "
+              "or point --home at a light store with persisted trust.",
               file=sys.stderr)
         return 1
     if args.trusted_hash and args.trusted_height <= 0:
@@ -327,6 +346,8 @@ def cmd_light(args) -> int:
         trusted_hash=bytes.fromhex(args.trusted_hash)
         if args.trusted_hash else b"",
         host=host, port=port,
+        db_path=(os.path.join(args.home, "light.db")
+                 if args.home else None),
     )
     proxy.start()
     print(f"light proxy listening on {proxy.address} "
@@ -411,6 +432,9 @@ def main(argv=None) -> int:
     p.add_argument("--trusted-hash", default="")
     p.add_argument("--insecure-trust", action="store_true",
                    help="allow trust-on-first-use without a pinned hash")
+    p.add_argument("--home", default="",
+                   help="light-client home dir; persists verified trust "
+                        "to <home>/light.db (light/store/db)")
     # 8888 like the reference light proxy — NOT in the 2665x node-port
     # range (26658 is the conventional ABCI proxy_app port)
     p.add_argument("--laddr", default="tcp://127.0.0.1:8888")

@@ -102,6 +102,7 @@ class Client:
         max_clock_drift: float = 10.0,
         batch_fn: Optional[Callable] = None,
         skipping: bool = True,
+        store: Optional["TrustedStore"] = None,
     ):
         self.chain_id = chain_id
         self.primary = primary
@@ -111,7 +112,9 @@ class Client:
         self.max_clock_drift = max_clock_drift
         self.batch_fn = batch_fn
         self.skipping = skipping
-        self.store = TrustedStore()
+        # any object with the TrustedStore surface; pass light.store.
+        # DBStore for durable trust across restarts (light/store/db/db.go)
+        self.store = store if store is not None else TrustedStore()
         # instrumentation for tests/benchmarks (bisection step count)
         self.verifications = 0
         # divergence reporting hook: receives LightClientAttackEvidence

@@ -31,11 +31,16 @@ class LightProxy:
                  trusted_height: int = 0, trusted_hash: bytes = b"",
                  trusting_period: float = 14 * 24 * 3600.0,
                  host: str = "127.0.0.1", port: int = 0,
-                 batch_fn=None):
+                 batch_fn=None, db_path: Optional[str] = None):
         from cometbft_tpu.light.client import Client
 
         self.chain_id = chain_id
         self.http = HTTPClient(primary)
+        store = None
+        if db_path:
+            from cometbft_tpu.light.store import DBStore
+
+            store = DBStore(db_path)
         self.client = Client(
             chain_id,
             light_provider(chain_id, primary),
@@ -43,6 +48,7 @@ class LightProxy:
                        for w in (witnesses or [])],
             trusting_period=trusting_period,
             batch_fn=batch_fn,
+            store=store,
         )
         if trusted_hash and trusted_height <= 0:
             raise LightProxyError(
@@ -65,8 +71,29 @@ class LightProxy:
         at the trusted height and pin it against the operator-supplied
         hash. Lazy so the proxy can start before the primary."""
         with self._boot_lock:
-            if self.client.store.latest() is not None:
-                return
+            latest = self.client.store.latest()
+            if latest is not None:
+                from cometbft_tpu.light.verifier import header_expired
+                from cometbft_tpu.types.timestamp import Timestamp
+
+                if not header_expired(
+                    latest.signed_header.header,
+                    self.client.trusting_period,
+                    Timestamp.now(),
+                ):
+                    return
+                # persisted root older than the trusting period: it can
+                # no longer anchor verification. Re-bootstrap from the
+                # operator's TrustOptions if given (the reference's
+                # restart-after-downtime path); without them fall
+                # through to the TOFU warning rather than wedging.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "light proxy: persisted trust root at height %d has "
+                    "expired; re-bootstrapping from trust options",
+                    latest.height,
+                )
             if not self._trusted_hash:
                 # trust-on-first-use: the primary picks the root — fine
                 # for dev, a real deployment must pin the hash (the

@@ -297,6 +297,10 @@ class Node(BaseService):
         self.pruner.start()
         if self.switch is not None:
             self.switch.start()
+        if getattr(self, "pex_reactor", None) is not None:
+            # redial from the persisted book immediately (node/node.go
+            # DialPeersAsync from the addrbook on start)
+            self.pex_reactor.start_routines()
         if getattr(self, "statesync_syncer", None) is not None:
             import threading
 

@@ -27,7 +27,25 @@ MIN_REQUEST_INTERVAL = 5.0  # per-peer rate limit (ensurePeersPeriod shape)
 
 
 class AddrBook:
-    """Persisted known-address set (p2p/pex/addrbook.go)."""
+    """Persisted two-tier address book (p2p/pex/addrbook.go).
+
+    Entries live in one of two tiers, mirroring the reference's
+    new/old bucket split (addrbook.go:32-47):
+      * "new"  — heard about via PEX but never connected to; these are
+        the attack surface for address poisoning, so they're capped per
+        source and evicted first.
+      * "old"  — we successfully connected at least once (markGood
+        promotes, addrbook.go:474); survive restarts as the primary
+        redial set and are never displaced by gossip.
+    Persistence is a JSON snapshot (saveToFile/loadFromFile shape,
+    addrbook.go:854-947) written on every mark_good, on a periodic
+    timer in the PEX reactor, and at shutdown — so a crash loses at
+    most the newest gossip, not the tried set.
+    """
+
+    MAX_NEW = 1024          # eviction cap for the unproven tier
+    MAX_ATTEMPTS = 5        # new entries over this are dropped;
+                            # old entries are demoted back to new
 
     def __init__(self, path: Optional[str] = None,
                  max_per_source: int = 50):
@@ -35,6 +53,11 @@ class AddrBook:
         self.max_per_source = max_per_source
         self._addrs: Dict[str, dict] = {}  # node_id -> entry
         self._lock = threading.Lock()
+        # serializes whole save() calls: mark_good (per-peer threads),
+        # the pex-ensure timer and stop_routines can all save
+        # concurrently, and interleaved writes to the same .tmp file
+        # would corrupt the book
+        self._save_lock = threading.Lock()
         if path and os.path.exists(path):
             self._load()
 
@@ -42,47 +65,81 @@ class AddrBook:
         with open(self.path) as f:
             doc = json.load(f)
         for e in doc.get("addrs", []):
+            e.setdefault("bucket", "new")
             self._addrs[e["id"]] = e
 
     def save(self) -> None:
         if not self.path:
             return
-        with self._lock:
-            doc = {"addrs": list(self._addrs.values())}
-        tmp = self.path + ".tmp"
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, self.path)
+        with self._save_lock:
+            with self._lock:
+                doc = {"addrs": [dict(e) for e in self._addrs.values()]}
+            tmp = self.path + ".tmp"
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
 
     def add(self, addr: NetAddress, source: str = "") -> bool:
         with self._lock:
             if addr.node_id in self._addrs:
                 return False
             n_from_source = sum(
-                1 for e in self._addrs.values() if e["src"] == source
+                1 for e in self._addrs.values()
+                if e["src"] == source and e["bucket"] == "new"
             )
             if source and n_from_source >= self.max_per_source:
                 return False  # cap what one peer can fill the book with
             self._addrs[addr.node_id] = {
                 "id": addr.node_id, "host": addr.host, "port": addr.port,
                 "src": source, "attempts": 0, "last_success": 0.0,
-                "banned": False,
+                "banned": False, "bucket": "new",
             }
+            self._evict_new_locked()
             return True
 
+    def _evict_new_locked(self) -> None:
+        """Cap the unproven tier (addrbook.go expireNew): drop the
+        most-failed, then oldest, new entries over MAX_NEW."""
+        news = [e for e in self._addrs.values() if e["bucket"] == "new"]
+        if len(news) <= self.MAX_NEW:
+            return
+        news.sort(key=lambda e: (-e["attempts"], e["last_success"]))
+        for e in news[: len(news) - self.MAX_NEW]:
+            del self._addrs[e["id"]]
+
     def mark_good(self, node_id: str) -> None:
+        """Successful connection: promote to the tried tier
+        (addrbook.go:474 MarkGood -> moveToOld)."""
+        promoted = False
         with self._lock:
             e = self._addrs.get(node_id)
             if e:
                 e["attempts"] = 0
                 e["last_success"] = time.time()
+                promoted = e["bucket"] != "old"
+                e["bucket"] = "old"
+        if promoted:
+            # tried addresses are the restart redial set — persist them
+            # eagerly, not just on the periodic timer
+            self.save()
 
     def mark_attempt(self, node_id: str) -> None:
         with self._lock:
             e = self._addrs.get(node_id)
-            if e:
-                e["attempts"] += 1
+            if not e:
+                return
+            e["attempts"] += 1
+            if e["attempts"] > self.MAX_ATTEMPTS:
+                if e["bucket"] == "old":
+                    # repeatedly unreachable tried peer: demote with a
+                    # reset attempt count (addrbook.go moveToNew on
+                    # eviction) — it stays dialable at new-tier priority
+                    # and is dropped if it keeps failing
+                    e["bucket"] = "new"
+                    e["attempts"] = 0
+                else:
+                    del self._addrs[node_id]
 
     def mark_bad(self, node_id: str) -> None:
         with self._lock:
@@ -90,19 +147,28 @@ class AddrBook:
             if e:
                 e["banned"] = True
 
-    def pick(self, exclude: Optional[set] = None) -> Optional[NetAddress]:
-        """Random dialable address, biased to fewer failed attempts."""
+    def pick(self, exclude: Optional[set] = None,
+             bias_new: float = 0.3) -> Optional[NetAddress]:
+        """Random dialable address (addrbook.go:303 PickAddress):
+        choose the tried tier with prob 1-bias_new, then a low-attempt
+        candidate at random within the tier."""
         exclude = exclude or set()
         with self._lock:
             cands = [
                 e for e in self._addrs.values()
                 if not e["banned"] and e["id"] not in exclude
-                and e["attempts"] < 5
+                and e["attempts"] < self.MAX_ATTEMPTS
             ]
         if not cands:
             return None
-        cands.sort(key=lambda e: e["attempts"])
-        pool = cands[: max(1, len(cands) // 2)]
+        old = [e for e in cands if e["bucket"] == "old"]
+        new = [e for e in cands if e["bucket"] != "old"]
+        if old and new:
+            tier = new if random.random() < bias_new else old
+        else:
+            tier = old or new
+        tier.sort(key=lambda e: e["attempts"])
+        pool = tier[: max(1, len(tier) // 2)]
         e = random.choice(pool)
         return NetAddress(e["id"], e["host"], e["port"])
 
@@ -124,17 +190,33 @@ class PEXReactor(Reactor):
     """pex_reactor.go:130 — gossip addresses, keep the switch peered."""
 
     def __init__(self, book: AddrBook, ensure_interval: float = 2.0,
-                 target_peers: int = 10, seed_mode: bool = False):
+                 target_peers: int = 10, seed_mode: bool = False,
+                 save_interval: float = 120.0):
         super().__init__("PEX")
         self.book = book
         self.ensure_interval = ensure_interval
         self.target_peers = target_peers
         self.seed_mode = seed_mode
+        self.save_interval = save_interval  # addrbook.go saveRoutine 2m
+        self._last_save = time.time()
         self._last_request: Dict[str, float] = {}
         self._requested: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+
+    def start_routines(self) -> None:
+        """Start the ensure-peers loop. Called by the node at start so a
+        restarted node redials its persisted book even with zero live
+        peers (without this the loop only woke on the first inbound
+        peer — a restart into an empty network would never redial)."""
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._ensure_peers_routine, daemon=True,
+                    name="pex-ensure",
+                )
+                self._thread.start()
 
     def channel_descriptors(self) -> List[ChannelDescriptor]:
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
@@ -155,12 +237,7 @@ class PEXReactor(Reactor):
                 pass
         self.book.mark_good(peer.peer_id)
         self._request_addrs(peer)
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._ensure_peers_routine, daemon=True,
-                name="pex-ensure",
-            )
-            self._thread.start()
+        self.start_routines()
 
     def remove_peer(self, peer: Peer, reason: str) -> None:
         with self._lock:
@@ -185,6 +262,9 @@ class PEXReactor(Reactor):
             sw = self.switch
             if sw is None or not sw.is_running():
                 continue
+            if time.time() - self._last_save >= self.save_interval:
+                self._last_save = time.time()
+                self.book.save()  # addrbook.go:854 saveRoutine
             if sw.num_peers() >= self.target_peers:
                 continue
             have = set(sw.peers.keys()) | {sw.node_key.node_id}
