@@ -30,15 +30,19 @@ def _connect(addr: str):
 
 
 def cmd_serve(args) -> int:
-    """abci-cli kvstore: run the example app as a socket server."""
+    """abci-cli kvstore: run the example app as a socket or gRPC
+    server (abci-cli.go --abci / grpc_server.go)."""
     from cometbft_tpu.abci.kvstore import KVStoreApplication
-    from cometbft_tpu.abci.server import ABCISocketServer
 
-    srv = ABCISocketServer(KVStoreApplication(), host=args.host,
-                           port=args.port)
+    if getattr(args, "transport", "socket") == "grpc":
+        from cometbft_tpu.abci.grpc import ABCIGRPCServer as Server
+    else:
+        from cometbft_tpu.abci.server import ABCISocketServer as Server
+
+    srv = Server(KVStoreApplication(), host=args.host, port=args.port)
     srv.start()
-    print(f"abci kvstore serving on {srv.addr[0]}:{srv.addr[1]}",
-          flush=True)
+    print(f"abci kvstore serving on {srv.addr[0]}:{srv.addr[1]} "
+          f"({getattr(args, 'transport', 'socket')})", flush=True)
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
@@ -114,6 +118,9 @@ def add_abci_subcommands(sub) -> None:
     q.add_argument("--host", default="127.0.0.1")
     q.add_argument("--port", type=int, default=26658)
     q.add_argument("--run-for", type=float, default=0)
+    q.add_argument("--transport", choices=("socket", "grpc"),
+                   default="socket",
+                   help="ABCI server transport (abci-cli.go --abci)")
     q.set_defaults(fn=cmd_serve)
 
     q = asub.add_parser("console", help="interactive ABCI console")

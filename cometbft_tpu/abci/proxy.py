@@ -70,6 +70,35 @@ class AppConns:
         return cls(*(ABCISocketClient(host, port, timeout=timeout)
                      for _ in range(4)))
 
+    @classmethod
+    def grpc(cls, host: str, port: int, timeout: float = 30.0
+             ) -> "AppConns":
+        """Four logical conns over ONE multiplexed gRPC channel
+        (grpc_client.go: HTTP/2 streams replace the socket client's
+        per-connection ordering mutex)."""
+        from cometbft_tpu.abci.grpc import ABCIGRPCClient
+
+        client = ABCIGRPCClient(host, port, timeout=timeout)
+        conns = cls(client, client, client, client)
+        conns._grpc_client = client
+        return conns
+
+    @classmethod
+    def from_addr(cls, addr: str, timeout: float = 30.0) -> "AppConns":
+        """proxy_app address -> AppConns: ``tcp://h:p`` or ``h:p``
+        (socket server), ``grpc://h:p`` (gRPC server) — the
+        proxy.DefaultClientCreator dispatch (proxy/client.go)."""
+        scheme, sep, rest = addr.partition("://")
+        if not sep:
+            scheme, rest = "tcp", addr
+        host, _, port = rest.rpartition(":")
+        host = host or "127.0.0.1"
+        if scheme == "grpc":
+            return cls.grpc(host, int(port), timeout=timeout)
+        if scheme in ("tcp", "socket"):
+            return cls.socket(host, int(port), timeout=timeout)
+        raise ValueError(f"unknown proxy_app scheme {scheme!r}")
+
     def close(self) -> None:
         for c in (self.consensus, self.mempool, self.query, self.snapshot):
             close = getattr(c, "close", None)

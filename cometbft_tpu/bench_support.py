@@ -112,31 +112,47 @@ def mixed_commit_bench(chain_id: str, n_vals: int = 10_000,
     rows_sr = pack_group(sr_rows, sr=True)
     pack_ms = _now_ms() - t_pack
 
+    import functools
+
+    import jax.numpy as jnp
+
+    # ONE compiled program: both key-type kernels + the cross-group
+    # tally sum + the quorum compare, all device-side (round-4 verdict:
+    # "fuse the ed25519+sr25519 tallies device-side into one quorum
+    # answer" — the host 6-limb add also forced two separate syncs)
+    @functools.partial(jax.jit, static_argnames=())
+    def fused_pass(red, rsr, base, th6):
+        v_ed, t_ed, _ = kp._verify_tally_rows.__wrapped__(red, base, 1)
+        v_sr, t_sr, _ = srk._verify_tally_rows_sr.__wrapped__(
+            rsr, base, 1)
+        tot = t_ed + t_sr
+        for i in range(ek.TALLY_LIMBS - 1):
+            c = tot[..., i] >> ek.POWER_LIMB_BITS
+            tot = tot.at[..., i].add(-(c << ek.POWER_LIMB_BITS)) \
+                     .at[..., i + 1].add(c)
+        return v_ed, v_sr, tot, ek.quorum_core(tot, th6)
+
+    th6 = jnp.asarray(ek.threshold_limbs(threshold))
+    base = kp.base_dev()
+
     def one_pass(red, rsr):
-        v_ed, t_ed, _ = kp.verify_tally_rows(red, 1)
-        v_sr, t_sr, _ = srk.verify_tally_rows(rsr, 1)
-        return v_ed, t_ed, v_sr, t_sr
+        return fused_pass(red, rsr, base, th6)
 
     d_ed = jax.device_put(rows_ed)
     d_sr = jax.device_put(rows_sr)
-    v_ed, t_ed, v_sr, t_sr = one_pass(d_ed, d_sr)
+    v_ed, v_sr, tot, quorum = one_pass(d_ed, d_sr)
     ed_ok = np.asarray(v_ed)[: len(ed_rows)].all()
     sr_ok = np.asarray(v_sr)[: len(sr_rows)].all()
-    got_power = tally_int(np.asarray(t_ed)[0]) + tally_int(
-        np.asarray(t_sr)[0]
-    )
+    got_power = tally_int(np.asarray(tot)[0])
     assert ed_ok and sr_ok, "mixed commit must verify"
     assert got_power == total_power
-    assert got_power > threshold
+    assert bool(np.asarray(quorum)[0])
 
     t = _now_ms()
     outs = None
     for _ in range(steady_k):
         outs = one_pass(jax.device_put(rows_ed), jax.device_put(rows_sr))
-    q = tally_int(np.asarray(outs[1])[0]) + tally_int(
-        np.asarray(outs[3])[0]
-    )
-    assert q > threshold
+    assert bool(np.asarray(outs[3])[0])
     steady = (_now_ms() - t) / steady_k
 
     # CPU baseline: measured OpenSSL (C-speed) ed25519 verify per-sig,

@@ -104,12 +104,12 @@ class StreamVerifier:
         vs0 = jobs[0][1].vals
         if any(job.vals is not vs0 for _, job in jobs[1:]):
             return None
-        keys, _, keys_ok = self._valset_arrays(vs0)
+        keys, vpowers, keys_ok = self._valset_arrays(vs0)
         if not keys_ok or len(keys) < 2:
             return None
         from cometbft_tpu.ops import ed25519_cached as ec
 
-        return ec.table_for_pubs(keys)
+        return ec.table_for_pubs(keys, vpowers)
 
     def _pack_chunk_cached(self, jobs, table) -> Optional[_Chunk]:
         """Strided pack for the cached-table kernel: commit c occupies
@@ -134,9 +134,8 @@ class StreamVerifier:
         row_job: List[int] = []
         row_idx: List[int] = []
         row_pos: List[int] = []
-        powers: List[int] = []
         row_ts: List[tuple] = []
-        keys, vpowers, _ = self._valset_arrays(jobs[0][1].vals)
+        keys, _, _ = self._valset_arrays(jobs[0][1].vals)
         nvals = len(keys)
         for j, (_, job) in enumerate(jobs):
             css = job.commit.signatures
@@ -151,7 +150,6 @@ class StreamVerifier:
             row_job += [j] * len(idxs)
             row_idx += idxs
             row_pos += [j * M + i for i in idxs]
-            powers += [vpowers[i] for i in idxs]
         if not pubs:
             return None
         n = len(pubs)
@@ -195,8 +193,6 @@ class StreamVerifier:
         hdig[pos] = hdig_d[:n]
         precheck = np.zeros(B, np.bool_)
         precheck[pos] = np.asarray(pre_d[:n], np.bool_)
-        power5 = np.zeros((B, ek.POWER_LIMBS), np.int32)
-        power5[pos] = ek.power_limbs(np.asarray(powers, np.int64))
         counted = np.zeros(B, np.bool_)
         counted[pos] = True
         commit_ids = np.zeros(B, np.int32)
@@ -209,7 +205,7 @@ class StreamVerifier:
                 job.vals.total_voting_power() * 2 // 3
             )[0]
         pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
-        rows = ec.pack_rows_cached(pb, power5, counted, commit_ids, thresh)
+        rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh)
         pending = ec.verify_tally_rows_cached(rows, table, cap)
         return _Chunk(list(jobs), np.asarray(row_job),
                       np.asarray(row_idx), pending, row_pos=pos)

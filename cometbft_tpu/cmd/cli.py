@@ -77,15 +77,24 @@ def build_node(home: str, cfg=None):
     cfg = cfg or load_config(_config_path(home))
     cfgdir = os.path.join(home, "config")
     doc = GenesisDoc.from_file(os.path.join(cfgdir, "genesis.json"))
-    if cfg.base.proxy_app != "kvstore":
+    pa = cfg.base.proxy_app
+    if pa == "kvstore":
+        app = KVStoreApplication()
+    elif "://" in pa or ":" in pa:
+        # out-of-process app: tcp:// socket or grpc:// server
+        # (proxy/client.go DefaultClientCreator address dispatch)
+        from cometbft_tpu.abci.proxy import AppConns
+
+        app = AppConns.from_addr(pa)
+    else:
         raise SystemExit(
-            f"unknown proxy_app {cfg.base.proxy_app!r} (in-process apps: "
-            f"kvstore; socket ABCI arrives with the abci server)"
+            f"unknown proxy_app {pa!r} (use 'kvstore', 'tcp://h:p' "
+            f"for a socket ABCI server, or 'grpc://h:p' for gRPC)"
         )
     import json as _json
 
     node = Node(
-        KVStoreApplication(),
+        app,
         doc.make_state(),
         privval=FilePV.load(cfgdir),
         home=os.path.join(home, "data"),
@@ -119,7 +128,7 @@ def cmd_start(args) -> int:
     print(f"p2p listening on {addr.host}:{addr.port} (id {addr.node_id})")
     if cfg.rpc.enabled:
         rh, rp = _parse_addr(cfg.rpc.laddr)
-        url = node.rpc_listen(rh, rp)
+        url = node.rpc_listen(rh, rp, unsafe=cfg.rpc.unsafe)
         print(f"rpc listening on {url}")
     for peer in filter(None, cfg.p2p.persistent_peers.split(",")):
         pid, hostport = peer.strip().split("@")
@@ -281,6 +290,137 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_reindex_event(args) -> int:
+    """reindex_event.go: rebuild the tx + block indexes from stored
+    blocks and FinalizeBlock responses — operator recovery after an
+    index wipe or an indexing bug. Node must be stopped (the command
+    opens the data dir directly, like the reference)."""
+    from cometbft_tpu.abci.types import ExecTxResult
+    from cometbft_tpu.state.indexer import BlockIndexer, TxIndexer
+    from cometbft_tpu.state.state import StateStore
+    from cometbft_tpu.store.blockstore import BlockStore
+
+    data = os.path.join(args.home, "data")
+    if not os.path.isdir(data):
+        print(f"no data dir at {data}", file=sys.stderr)
+        return 1
+    bs = BlockStore(os.path.join(data, "blockstore.db"))
+    ss = StateStore(os.path.join(data, "state.db"))
+    txi = TxIndexer(os.path.join(data, "tx_index.db"))
+    bli = BlockIndexer(os.path.join(data, "block_index.db"))
+    base, head = bs.base(), bs.height()
+    start = max(args.start_height or base, base, 1)
+    end = min(args.end_height or head, head)
+    if start > end:
+        print(f"invalid height range [{start}, {end}] "
+              f"(store has [{base}, {head}])", file=sys.stderr)
+        return 1
+    n_txs = 0
+    skipped = 0
+    for h in range(start, end + 1):
+        block = bs.load_block(h)
+        if block is None:
+            print(f"height {h}: block missing (pruned?), skipping")
+            continue
+        doc = ss.load_abci_responses(h)
+        results = (doc or {}).get("tx_results", [])
+        if block.data.txs and len(results) < len(block.data.txs):
+            # never fabricate results: indexing a failed tx as code=0
+            # would corrupt tx_search (the reference requires stored
+            # ABCI responses for every reindexed height)
+            print(f"height {h}: FinalizeBlock responses missing/pruned "
+                  f"({len(results)}/{len(block.data.txs)} results); "
+                  f"skipping its txs")
+            skipped += 1
+        else:
+            for i, tx in enumerate(block.data.txs):
+                rj = results[i]
+                res = ExecTxResult(
+                    code=rj.get("code", 0),
+                    data=bytes.fromhex(rj.get("data", "")),
+                    log=rj.get("log", ""),
+                    gas_wanted=rj.get("gas_wanted", 0),
+                    gas_used=rj.get("gas_used", 0),
+                )
+                txi.index(h, i, tx, res, rj.get("events") or {})
+                n_txs += 1
+        bli.index(h, {"block.proposer":
+                      [block.header.proposer_address.hex().upper()]})
+    for dbh in (bs, ss, txi, bli):
+        dbh.close()
+    print(f"reindexed heights [{start}, {end}]: {n_txs} txs"
+          + (f" ({skipped} heights skipped: no stored results)"
+             if skipped else ""))
+    return 0
+
+
+def _debug_collect(rpc_url: str, home: str, out_dir: str) -> list:
+    """One debug snapshot: RPC state + config + pprof-analog dumps
+    (debug/util.go dumpStatus/dumpNetInfo/dumpConsensusState +
+    copyConfig)."""
+    import urllib.request
+
+    os.makedirs(out_dir, exist_ok=True)
+    wrote = []
+
+    def fetch(path, name):
+        try:
+            with urllib.request.urlopen(rpc_url + path, timeout=5) as r:
+                body = r.read()
+            p = os.path.join(out_dir, name)
+            with open(p, "wb") as f:
+                f.write(body)
+            wrote.append(name)
+        except Exception as e:  # noqa: BLE001 - collect what we can
+            print(f"  {name}: unavailable ({e})")
+
+    fetch("/status", "status.json")
+    fetch("/net_info", "net_info.json")
+    fetch("/dump_consensus_state", "consensus_state.json")
+    fetch("/debug/pprof/goroutine", "stacks.txt")
+    fetch("/debug/pprof/heap", "heap.txt")
+    cfg = os.path.join(home, "config", "config.toml")
+    if os.path.exists(cfg):
+        shutil.copy(cfg, os.path.join(out_dir, "config.toml"))
+        wrote.append("config.toml")
+    return wrote
+
+
+def cmd_debug(args) -> int:
+    """debug.go: `debug kill <pid> <out.zip>` (capture state then kill
+    the node) and `debug dump <out-dir>` (periodic snapshots)."""
+    import tempfile
+    import zipfile
+
+    if args.debug_sub == "kill":
+        with tempfile.TemporaryDirectory() as td:
+            wrote = _debug_collect(args.rpc_laddr, args.home, td)
+            with zipfile.ZipFile(args.out, "w") as z:
+                for name in wrote:
+                    z.write(os.path.join(td, name), name)
+        print(f"wrote {args.out} ({len(wrote)} files)")
+        try:
+            os.kill(args.pid, signal.SIGTERM)
+            print(f"sent SIGTERM to {args.pid}")
+        except ProcessLookupError:
+            print(f"no such pid {args.pid}", file=sys.stderr)
+            return 1
+        return 0
+    # dump mode: one snapshot per --frequency seconds until --count
+    os.makedirs(args.out, exist_ok=True)
+    n = 0
+    while args.count <= 0 or n < args.count:
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        out = os.path.join(args.out, ts)
+        wrote = _debug_collect(args.rpc_laddr, args.home, out)
+        print(f"snapshot {ts}: {len(wrote)} files")
+        n += 1
+        if args.count > 0 and n >= args.count:
+            break
+        time.sleep(args.frequency)
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """inspect.go: read-only RPC over a stopped node's data dirs."""
     from cometbft_tpu.inspect import InspectServer
@@ -417,6 +557,33 @@ def main(argv=None) -> int:
     p.add_argument("--laddr", default="tcp://127.0.0.1:26661")
     p.add_argument("--run-for", type=float, default=0)
     p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("reindex-event",
+                       help="rebuild tx/block indexes from stored "
+                            "blocks (reindex_event.go)")
+    _home_arg(p)
+    p.add_argument("--start-height", type=int, default=0)
+    p.add_argument("--end-height", type=int, default=0)
+    p.set_defaults(fn=cmd_reindex_event)
+
+    p = sub.add_parser("debug",
+                       help="capture node state for an incident "
+                            "(debug.go dump/kill)")
+    dsub = p.add_subparsers(dest="debug_sub", required=True)
+    q = dsub.add_parser("kill", help="collect state then SIGTERM")
+    q.add_argument("pid", type=int)
+    q.add_argument("out", help="output zip path")
+    _home_arg(q)
+    q.add_argument("--rpc-laddr", default="http://127.0.0.1:26657")
+    q.set_defaults(fn=cmd_debug)
+    q = dsub.add_parser("dump", help="periodic state snapshots")
+    q.add_argument("out", help="output directory")
+    _home_arg(q)
+    q.add_argument("--rpc-laddr", default="http://127.0.0.1:26657")
+    q.add_argument("--frequency", type=float, default=30.0)
+    q.add_argument("--count", type=int, default=0,
+                   help="stop after N snapshots (0 = forever)")
+    q.set_defaults(fn=cmd_debug)
 
     from cometbft_tpu.abci.cli import add_abci_subcommands
 

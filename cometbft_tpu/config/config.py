@@ -30,6 +30,9 @@ class BaseConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     enabled: bool = True
+    # serve dial_seeds/dial_peers/unsafe_flush_mempool + /debug/pprof
+    # (config.go RPCConfig.Unsafe + PprofListenAddress)
+    unsafe: bool = False
 
 
 @dataclass

@@ -108,6 +108,11 @@ def _load() -> Optional[ctypes.CDLL]:
         u64arr = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
         lib.batch_keccak_f1600.argtypes = [u64arr, ctypes.c_uint64]
         lib.batch_keccak_f1600.restype = None
+        lib.sr25519_batch_challenges.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            u8p, ctypes.c_uint64, u8p, u8p, ctypes.c_uint64, u8p,
+        ]
+        lib.sr25519_batch_challenges.restype = None
         _lib = lib
         return _lib
 
@@ -297,5 +302,28 @@ def batch_reduce_mod_l(digests: np.ndarray) -> Optional[np.ndarray]:
     out = np.empty((n, 32), np.uint8)
     lib.batch_reduce_mod_l(
         np.ascontiguousarray(digests.reshape(n, 64)), n, out
+    )
+    return out
+
+
+def sr25519_batch_challenges(prefix_state: bytes, pos: int,
+                             pos_begin: int, cur_flags: int,
+                             msgs: np.ndarray, pks: np.ndarray,
+                             rs: np.ndarray) -> Optional[np.ndarray]:
+    """Whole sr25519 merlin challenge transcripts in one native call:
+    (n, L) msgs + (n, 32) pks + (n, 32) R encodings -> (n, 64) raw
+    challenge bytes. None without the native library (callers keep the
+    numpy BatchStrobe route — the differential reference,
+    tests/test_native.py)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = msgs.shape[0]
+    out = np.empty((n, 64), np.uint8)
+    lib.sr25519_batch_challenges(
+        np.frombuffer(prefix_state, np.uint8), pos, pos_begin,
+        cur_flags, np.ascontiguousarray(msgs, np.uint8),
+        msgs.shape[1], np.ascontiguousarray(pks, np.uint8),
+        np.ascontiguousarray(rs, np.uint8), n, out,
     )
     return out

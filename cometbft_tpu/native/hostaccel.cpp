@@ -505,12 +505,127 @@ inline void f1600_one(u64* s /* 25 lanes, order x + 5y */) {
 
 }  // namespace
 
+// ---- STROBE-128 / merlin transcripts ----------------------------------
+// Full sr25519 challenge transcripts in native code
+// (crypto/merlin.py Strobe128 semantics, differential-tested in
+// tests/test_native.py). The numpy BatchStrobe route pays python+numpy
+// dispatch for every transcript op (~70 ms host_pack for a 5k-row
+// mixed commit, round-4 verdict cfg3 weakness); one C call walks each
+// lane's whole transcript.
+
+namespace {
+
+constexpr int SR = 166;  // STROBE-128 rate: 200 - 2*16 - 2
+constexpr u8 SFLAG_I = 1, SFLAG_A = 2, SFLAG_C = 4, SFLAG_M = 16,
+             SFLAG_K = 32;
+
+struct Strobe {
+  u8 st[200];
+  int pos, pos_begin;
+  u8 cur_flags;
+
+  void run_f() {
+    st[pos] ^= (u8)pos_begin;
+    st[pos + 1] ^= 0x04;
+    st[SR + 1] ^= 0x80;
+    u64 lanes[25];
+    memcpy(lanes, st, 200);
+    f1600_one(lanes);
+    memcpy(st, lanes, 200);
+    pos = 0;
+    pos_begin = 0;
+  }
+
+  void absorb(const u8* data, u64 len) {
+    for (u64 i = 0; i < len; i++) {
+      st[pos] ^= data[i];
+      if (++pos == SR) run_f();
+    }
+  }
+
+  void squeeze(u8* out, u64 len) {
+    for (u64 i = 0; i < len; i++) {
+      out[i] = st[pos];
+      st[pos] = 0;
+      if (++pos == SR) run_f();
+    }
+  }
+
+  void begin_op(u8 flags, bool more) {
+    if (more) return;
+    u8 hdr[2] = {(u8)pos_begin, flags};
+    pos_begin = pos + 1;
+    cur_flags = flags;
+    absorb(hdr, 2);
+    if ((flags & (SFLAG_C | SFLAG_K)) && pos != 0) run_f();
+  }
+
+  void meta_ad(const u8* d, u64 n, bool more) {
+    begin_op(SFLAG_M | SFLAG_A, more);
+    absorb(d, n);
+  }
+  void ad(const u8* d, u64 n, bool more) {
+    begin_op(SFLAG_A, more);
+    absorb(d, n);
+  }
+  void prf(u8* out, u64 n) {
+    begin_op(SFLAG_I | SFLAG_A | SFLAG_C, false);
+    squeeze(out, n);
+  }
+
+  void append_message(const u8* label, u64 ll, const u8* msg, u64 ml) {
+    u8 len4[4] = {(u8)ml, (u8)(ml >> 8), (u8)(ml >> 16), (u8)(ml >> 24)};
+    meta_ad(label, ll, false);
+    meta_ad(len4, 4, true);
+    ad(msg, ml, false);
+  }
+
+  void challenge(const u8* label, u64 ll, u8* out, u64 n) {
+    u8 len4[4] = {(u8)n, (u8)(n >> 8), (u8)(n >> 16), (u8)(n >> 24)};
+    meta_ad(label, ll, false);
+    meta_ad(len4, 4, true);
+    prf(out, n);
+  }
+};
+
+}  // namespace
+
 extern "C" {
 
 // In-place batched keccak-f[1600]: states is n x 25 little-endian u64
 // lanes (x + 5y order, matching keccak.py).
 void batch_keccak_f1600(u64* states, u64 n) {
   for (u64 i = 0; i < n; i++) f1600_one(states + 25 * i);
+}
+
+// sr25519 (schnorrkel) batch challenge derivation: each lane clones the
+// signing-context prefix transcript and runs
+//   append_message("sign-bytes", msg)
+//   append_message("proto-name", "Schnorr-sig")
+//   append_message("sign:pk", pk)   append_message("sign:R", R)
+//   challenge_bytes("sign:c", 64)
+// (crypto/sr25519/batch.go:44-77 / sr25519_ref.challenge_scalar).
+// prefix: 200-byte STROBE state + pos/pos_begin/cur_flags of the shared
+// signing context; msgs is n x msg_len (caller groups rows by length).
+void sr25519_batch_challenges(const u8* prefix, int pos, int pos_begin,
+                              int cur_flags, const u8* msgs, u64 msg_len,
+                              const u8* pks /* n x 32 */,
+                              const u8* rs /* n x 32 */, u64 n,
+                              u8* out /* n x 64 */) {
+  for (u64 i = 0; i < n; i++) {
+    Strobe s;
+    memcpy(s.st, prefix, 200);
+    s.pos = pos;
+    s.pos_begin = pos_begin;
+    s.cur_flags = (u8)cur_flags;
+    s.append_message((const u8*)"sign-bytes", 10, msgs + i * msg_len,
+                     msg_len);
+    s.append_message((const u8*)"proto-name", 10,
+                     (const u8*)"Schnorr-sig", 11);
+    s.append_message((const u8*)"sign:pk", 7, pks + i * 32, 32);
+    s.append_message((const u8*)"sign:R", 6, rs + i * 32, 32);
+    s.challenge((const u8*)"sign:c", 6, out + i * 64, 64);
+  }
 }
 
 int hostaccel_abi_version() { return 1; }

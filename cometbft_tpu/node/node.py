@@ -281,12 +281,14 @@ class Node(BaseService):
         """Start the p2p listener; returns our NetAddress."""
         return self.switch.listen(host, port)
 
-    def rpc_listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
+    def rpc_listen(self, host: str = "127.0.0.1", port: int = 0,
+                   unsafe: bool = False) -> str:
         """Start the JSON-RPC server (node/node.go:527 RPC listeners);
-        returns the base URL."""
+        returns the base URL. unsafe=True adds the ops routes +
+        profiling endpoints (rpc/core/routes.go:58)."""
         from cometbft_tpu.rpc.server import RPCServer
 
-        self.rpc_server = RPCServer(self, host, port)
+        self.rpc_server = RPCServer(self, host, port, unsafe=unsafe)
         self.rpc_server.start()
         return self.rpc_server.address
 

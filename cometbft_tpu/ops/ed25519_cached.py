@@ -4,8 +4,8 @@ The general Pallas kernel (ops.ed25519_pallas) pays, per signature, a
 full point decompression of the pubkey A plus 252 accumulator doublings
 for h*(-A). But consensus verifies thousands of commits against the SAME
 validator set — valsets change slowly (one update per block at most), so
-the A-side work can be hoisted into a device-resident table built once
-per valset and amortized to ~zero:
+the A-side work hoists into a device-resident table built once per
+valset (and incrementally patched on epoch churn, `update_table`):
 
   for each validator, precompute  [d] * (2^(32j) * (-A))  for the 8 base
   points j=0..7 and window digits d=0..15, stored in affine "niels" form
@@ -15,20 +15,33 @@ per valset and amortized to ~zero:
 
   is a Horner loop of only 7x4 = 28 doublings + 64 mixed adds (7 muls
   each) — versus 252 doublings + 63 unified adds (9 muls) + a 15-add
-  per-signature table build + a ~250-squaring sqrt chain in the general
-  kernel. The per-window entries are fetched by one XLA gather keyed on
-  (validator index, digit) and streamed into the kernel per 128-lane
-  tile; the R-side decompression (per-signature nonce) remains in-kernel.
+  per-signature table build in the general kernel.
+
+Round-5 design (this file):
+  * the table lives in the kernel's OWN input layout — tile i of a
+    batch reads exactly table block (i mod M/128) via a static
+    BlockSpec index_map, and the per-lane 16-way entry select is a
+    4-level where-tree over in-VMEM int16 slices. (The round-4 design
+    gathered entries with an MXU one-hot einsum OUTSIDE the kernel;
+    its HBM traffic + transposes cost more than the curve math.)
+  * R is never decompressed. ZIP-215's cofactored equation
+    8([S]B) == 8R + 8([h]A) is checked as: exists T in E[8] with
+    W + T == decompress(R), W = [S]B + [h](-A) — eight torsion
+    candidates compared projectively against the R encoding, with the
+    sign bit resolved by ONE Montgomery-tree batched inversion in the
+    XLA epilogue. This deletes the per-lane ~250-squaring sqrt chain
+    AND the cofactor doublings AND the in-kernel canonical compares.
+  * voting power rides in the table (valset data), so per-commit
+    uploads carry only R/s/h/flags — 27 rows = 108 B/signature.
 
 This mirrors the amortization the reference gets from its ed25519 batch
 verifier over long-lived validator sets (crypto/ed25519/ed25519.go:
-208-241 BatchVerifier; types/validation.go:153 verifyCommitBatch) — but
-with the precomputation shaped for TPU: the table lives in HBM
-(~320 KB per 1k validators), entries ride one gather + one H2D-free
-kernel input, and the [S]B comb stays on the MXU.
+208-241 BatchVerifier; types/validation.go:153 verifyCommitBatch;
+types/validator_set.go:589-651 updateWithChangeSet for the churn path).
 
 Semantics are identical ZIP-215 (differential tests against the
-pure-Python oracle and the general kernel in tests/test_ed25519_cached).
+pure-Python oracle incl. small-order/non-canonical/-0 edge cases in
+tests/test_ed25519_cached).
 """
 from __future__ import annotations
 
@@ -51,17 +64,12 @@ from cometbft_tpu.ops.field import F25519, NLIMBS
 from cometbft_tpu.ops.ed25519_pallas import (
     B_TILE,
     F,
-    _D_T,
     _D2_T,
-    _SQRT_M1_T,
     _M13,
-    decompress,
     pt_add,
-    pt_add_noT,
     pt_double,
     pt_double_p,
     pt_identity,
-    pt_neg,
 )
 from cometbft_tpu.ops.field_lf import const_col
 
@@ -75,17 +83,22 @@ NIELS_ROWS = 3 * NLIMBS
 ROWS_PER_ENT = 64
 
 # Compact packed-row layout for the cached path. No pubkey rows (the
-# table IS the pubkey) and no validator-index row (vidx[b] == b mod M
-# by construction, so the device derives it from an iota). The upload
-# rides the same serialized tunnel stream as compute on this backend,
-# so every row is ~0.35 ms/10k-batch of steady-state latency.
+# table IS the pubkey), no validator-index row (vidx[b] == b mod M by
+# construction, so the device derives it from an iota), and no power
+# rows (voting power is VALSET data — it rides in the device table,
+# uploaded once per valset, not per commit). The upload rides the same
+# serialized tunnel stream as compute on this backend, so every row is
+# real steady-state latency.
 V_RY = 0        # 10 rows: sig R y limb pairs, word = l[i] | l[i+10] << 13
 V_S8 = 10       # 8 rows: byte digits of s (comb), digit d at row d%8
 V_H4 = 18       # 8 rows: nibble digits of h, digit d at row d%8
 V_FLAGS = 26    # rsign | precheck<<1 | counted<<2 | commit_id<<3
-V_KROWS = 27    # kernel block height (rows below are tally/gather side)
-V_POW = 27      # 3 rows: p0|p1<<13, p2|p3<<13, p4
-V_THRESH = 30   # flattened (n_commits, TALLY_LIMBS) thresholds
+V_KROWS = 27    # kernel block height (rows below are tally-side only)
+V_THRESH = 27   # flattened (n_commits, TALLY_LIMBS) thresholds
+
+# kernel output stanza per torsion candidate: ydiff @0, X @24, Z @48
+# (20-row fields in 24-row slots so every sublane store is 8-aligned)
+CAND_STRIDE = 72
 
 
 # --------------------------------------------------------------------------
@@ -161,15 +174,24 @@ def _build_core(ay, asign):
 
 
 @jax.jit
-def _split_i8(tbl):
-    """(M*128, 64) int32 -> ((M/128, 128, 128, 64) int8 lo, same hi).
+def _blocked_i16(tbl):
+    """(M*128, 64) int32 -> one (M/128 * 8192, 128) int16 array.
 
-    The aligned "gather" is a one-hot MXU matmul per (tile, lane); the
-    13-bit limbs are split into exact int8 halves (lo 7 bits / hi 6) so
-    both matmuls run at the MXU's full s8xs8->s32 rate."""
+    Kernel-native layout: row (blk*8192 + e*64 + r), lane v%128 holds
+    limb-row r of entry e for validator v = blk*128 + lane. Tile i of a
+    verification batch reads exactly block (i mod M/128) — a static
+    BlockSpec index_map, so the "gather" costs nothing outside the
+    kernel (the round-4 einsum gather burnt ~7 ms/10k-batch in HBM
+    traffic + transposes). Canonical 13-bit limbs fit int16 exactly —
+    same bytes as an int8 lo/hi split but half the in-kernel select
+    ops."""
     M = tbl.shape[0] // (NJ * NENT)
-    t = tbl.reshape(M // 128, 128, NJ * NENT, ROWS_PER_ENT)
-    return (t & 127).astype(jnp.int8), (t >> 7).astype(jnp.int8)
+    t = tbl.reshape(M // 128, 128, NJ * NENT * ROWS_PER_ENT)
+    t = t.transpose(0, 2, 1).reshape(-1, 128)
+    return t.astype(jnp.int16)
+
+
+ENT_BLOCK = NJ * NENT * ROWS_PER_ENT  # 8192 table rows per 128 validators
 
 
 class ValsetTable:
@@ -177,13 +199,23 @@ class ValsetTable:
 
     n_vals is the PADDED size M (multiple of 128); verification batches
     must carry vidx[b] == b mod M (commit rows are naturally in valset
-    order, so this holds by construction — see pack_rows_cached)."""
+    order, so this holds by construction — see pack_rows_cached).
 
-    def __init__(self, t_lo, t_hi, ok, n_vals: int):
-        self.t_lo = t_lo        # (M/128, 128, 128, 64) int8, device
-        self.t_hi = t_hi
+    Voting power lives here too: it is valset data, so it uploads once
+    with the table instead of riding every per-commit row batch."""
+
+    def __init__(self, tab, ok, power5, n_vals: int,
+                 pub_digest: Optional[np.ndarray] = None,
+                 powers_host: Optional[np.ndarray] = None):
+        self.tab = tab          # (M/128 * 8192, 128) int16, device
         self.ok = ok            # (M,) bool, device
+        self.power5 = power5    # (M, POWER_LIMBS) int32, device
         self.n_vals = n_vals
+        # per-slot 8-byte pubkey digests + host power copy — lets
+        # table_for_pubs find a near-miss cached table and compute the
+        # exact (pubkey, power) delta without a device round trip
+        self.pub_digest = pub_digest
+        self.powers_host = powers_host
 
 
 def table_pad(n: int) -> int:
@@ -191,10 +223,31 @@ def table_pad(n: int) -> int:
     return max(128, ek.bucket_size(max(n, 1)))
 
 
-def build_table(pub_bytes: Sequence[bytes]) -> ValsetTable:
-    """Build the device table for a list of 32-byte ed25519 pubkeys."""
-    n = len(pub_bytes)
-    padded = table_pad(n)
+def _pub_digests(pub_bytes: Sequence[bytes], padded: int) -> np.ndarray:
+    d = np.zeros((padded,), np.uint64)
+    for i, p in enumerate(pub_bytes):
+        d[i] = np.frombuffer(
+            hashlib.blake2b(p, digest_size=8).digest(), np.uint64
+        )[0]
+    return d
+
+
+def _power_dev(powers, padded: int):
+    p5 = np.zeros((padded, ek.POWER_LIMBS), np.int32)
+    if powers is not None:
+        n = len(powers)
+        p5[:n] = ek.power_limbs(np.asarray(powers, np.int64))
+    return jax.device_put(p5)
+
+
+def _powers_host(powers, padded: int) -> np.ndarray:
+    ph = np.zeros((padded,), np.int64)
+    if powers is not None:
+        ph[: len(powers)] = np.asarray(powers, np.int64)
+    return ph
+
+
+def _pack_pub_arrays(pub_bytes: Sequence[bytes], padded: int):
     a_raw = np.zeros((padded, 32), np.uint8)
     lenok = np.zeros(padded, np.bool_)
     for i, p in enumerate(pub_bytes):
@@ -203,21 +256,146 @@ def build_table(pub_bytes: Sequence[bytes]) -> ValsetTable:
             lenok[i] = True
     ay = F25519.from_bytes_le(a_raw, nbits=255)
     asign = (a_raw[:, 31] >> 7).astype(np.int32)
+    return ay, asign, lenok
+
+
+def build_table(pub_bytes: Sequence[bytes],
+                powers=None) -> ValsetTable:
+    """Build the device table for a list of 32-byte ed25519 pubkeys."""
+    n = len(pub_bytes)
+    padded = table_pad(n)
+    ay, asign, lenok = _pack_pub_arrays(pub_bytes, padded)
     tbl, ok = _build_core(jnp.asarray(ay), jnp.asarray(asign))
     ok = ok & jnp.asarray(lenok)
-    t_lo, t_hi = _split_i8(tbl)
-    return ValsetTable(t_lo, t_hi, ok, padded)
+    return ValsetTable(_blocked_i16(tbl), ok,
+                       _power_dev(powers, padded),
+                       padded, _pub_digests(pub_bytes, padded),
+                       _powers_host(powers, padded))
+
+
+# -- incremental update (validator-set churn) ------------------------------
+
+UPDATE_PAD = 128  # one lane tile: the epoch-delta build shape
+
+
+@jax.jit
+def _update_core(tab, ok, power5, ay, asign, lenok, idxs, sel,
+                 new_p5, psel):
+    """Device-pure incremental update — NOTHING round-trips the host
+    (on the tunneled backend a host bounce of the built columns cost
+    more than a full rebuild).
+
+    idxs: (UPDATE_PAD,) target slots (dead slots repeat slot 0 with
+    sel=0). sel masks which slots actually write; psel which powers.
+    """
+    tbl, ok_new = _build_core.__wrapped__(ay, asign)
+    ok_new = ok_new & lenok
+    # built rows (v*128 + e, 64) -> per-validator (ENT_BLOCK,) column
+    cols = tbl.reshape(UPDATE_PAD, ENT_BLOCK).astype(jnp.int16)
+
+    def body(k, st):
+        tab, ok, p5 = st
+        i = idxs[k]
+        col = jnp.where(
+            sel[k] != 0, cols[k],
+            jax.lax.dynamic_slice(
+                tab, ((i // 128) * ENT_BLOCK, i % 128), (ENT_BLOCK, 1)
+            )[:, 0],
+        )
+        tab = jax.lax.dynamic_update_slice(
+            tab, col[:, None], ((i // 128) * ENT_BLOCK, i % 128))
+        ok = ok.at[i].set(jnp.where(sel[k] != 0, ok_new[k], ok[i]))
+        p5 = p5.at[i].set(jnp.where(psel[k] != 0, new_p5[k], p5[i]))
+        return tab, ok, p5
+
+    return jax.lax.fori_loop(0, UPDATE_PAD, body, (tab, ok, power5))
+
+
+def update_table(table: ValsetTable, changes,
+                 powers_by_idx=None) -> ValsetTable:
+    """Incremental table update for a validator-set delta.
+
+    changes: list of (index, pubkey_bytes) for slots whose key changed
+    (or appeared — index may extend up to the table's padded size).
+    powers_by_idx: optional {index: power} for slots whose power
+    changed (power changes alone don't touch the curve table).
+
+    Epoch churn touches a handful of validators
+    (types/validator_set.go:589-651 updateWithChangeSet); rebuilding
+    all 10k costs a full table build (~1 s warm), while this path
+    builds only the changed windows (128-slot bucket) and scatters
+    them in place on device.
+    """
+    idx_list = [i for i, _ in changes]
+    if not all(0 <= i < table.n_vals for i in idx_list):
+        raise ValueError("change index beyond the table's padded size")
+    pw_items = list((powers_by_idx or {}).items())
+    # slots needing a write: key changes plus power-only changes that
+    # don't coincide with a key change
+    extra_pw = [i for i, _ in pw_items if i not in set(idx_list)]
+    if len(idx_list) + len(extra_pw) > UPDATE_PAD:
+        raise ValueError(
+            f"delta of {len(idx_list)}+{len(extra_pw)} slots exceeds "
+            f"UPDATE_PAD={UPDATE_PAD}; rebuild the table instead"
+        )
+    if not changes and not pw_items:
+        return table
+    pubs = [p for _, p in changes]
+    ay, asign, lenok = _pack_pub_arrays(pubs, UPDATE_PAD)
+    idxs = np.zeros(UPDATE_PAD, np.int32)
+    sel = np.zeros(UPDATE_PAD, np.int32)
+    idxs[: len(idx_list)] = idx_list
+    sel[: len(idx_list)] = 1
+    new_p5 = np.zeros((UPDATE_PAD, ek.POWER_LIMBS), np.int32)
+    psel = np.zeros(UPDATE_PAD, np.int32)
+    # power updates ride the same padded loop: slot k of the loop may
+    # write table column idxs[k] and/or power row pidx[k]; merge power
+    # targets into free slots' idxs when they don't coincide
+    pw_map = dict(pw_items)
+    for k, i in enumerate(idx_list):
+        if i in pw_map:
+            new_p5[k] = ek.power_limbs(
+                np.asarray([pw_map.pop(i)], np.int64))[0]
+            psel[k] = 1
+    free = len(idx_list)
+    for i, pw in pw_map.items():
+        assert free < UPDATE_PAD, "too many combined updates"
+        idxs[free] = i
+        new_p5[free] = ek.power_limbs(np.asarray([pw], np.int64))[0]
+        psel[free] = 1
+        free += 1
+    tab, ok, power5 = _update_core(
+        table.tab, table.ok, table.power5, jnp.asarray(ay),
+        jnp.asarray(asign), jnp.asarray(lenok), jnp.asarray(idxs),
+        jnp.asarray(sel), jnp.asarray(new_p5), jnp.asarray(psel),
+    )
+    dig = None
+    if table.pub_digest is not None:
+        dig = table.pub_digest.copy()
+        for (i, p) in changes:
+            dig[i] = np.frombuffer(
+                hashlib.blake2b(p, digest_size=8).digest(), np.uint64
+            )[0]
+    ph = None
+    if table.powers_host is not None:
+        ph = table.powers_host.copy()
+        for i, pw in pw_items:
+            ph[i] = pw
+    return ValsetTable(tab, ok, power5, table.n_vals, dig, ph)
 
 
 # LRU of built tables keyed by the pubkey list (order-sensitive: the
 # validator INDEX is the gather key). Commit verification presents the
-# same valset in the same order every block, so this hits ~always.
+# same valset in the same order every block, so this hits ~always; on
+# a miss, a cached table for a near-identical list (epoch churn) is
+# updated incrementally instead of rebuilt.
 _TABLE_CACHE: "OrderedDict[bytes, ValsetTable]" = OrderedDict()
 _TABLE_CACHE_MAX = 8
 _TABLE_LOCK = threading.Lock()
+MAX_INCREMENTAL = 64  # fall back to full rebuild above this delta
 
 
-def table_for_pubs(pub_bytes: Sequence[bytes]) -> ValsetTable:
+def _cache_key(pub_bytes: Sequence[bytes], powers) -> bytes:
     h = hashlib.sha256()
     for p in pub_bytes:
         # length-prefix each key so the digest is injective over the
@@ -225,13 +403,53 @@ def table_for_pubs(pub_bytes: Sequence[bytes]) -> ValsetTable:
         # signature to the wrong slot's table entries)
         h.update(len(p).to_bytes(8, "big"))
         h.update(p)
-    key = h.digest() + len(pub_bytes).to_bytes(4, "big")
+    if powers is not None:
+        for pw in powers:
+            h.update(int(pw).to_bytes(8, "big", signed=True))
+    return h.digest() + len(pub_bytes).to_bytes(4, "big")
+
+
+def table_for_pubs(pub_bytes: Sequence[bytes],
+                   powers=None) -> ValsetTable:
+    key = _cache_key(pub_bytes, powers)
     with _TABLE_LOCK:
         t = _TABLE_CACHE.get(key)
         if t is not None:
             _TABLE_CACHE.move_to_end(key)
             return t
-    t = build_table(pub_bytes)
+        # near-miss scan: same padded size, few changed slots -> update
+        # the cached table incrementally (valset churn between epochs)
+        base = None
+        padded = table_pad(len(pub_bytes))
+        digs = _pub_digests(pub_bytes, padded)
+        for cand in reversed(_TABLE_CACHE.values()):
+            if cand.n_vals != padded or cand.pub_digest is None:
+                continue
+            diff = np.nonzero(cand.pub_digest != digs)[0]
+            if diff.size <= MAX_INCREMENTAL:
+                base = (cand, diff)
+                break
+    t = None
+    if base is not None:
+        cand, diff = base
+        changes = [(int(i), pub_bytes[i] if i < len(pub_bytes) else b"")
+                   for i in diff]
+        pw_map = None
+        if powers is not None:
+            # only CHANGED powers ride the update (the full map
+            # crashed update_table's slot budget for valsets > 128 and
+            # rewrote every power row)
+            new_ph = _powers_host(powers, padded)
+            old_ph = (cand.powers_host if cand.powers_host is not None
+                      else np.zeros((padded,), np.int64))
+            pw_map = {int(i): int(new_ph[i])
+                      for i in np.nonzero(new_ph != old_ph)[0]}
+        try:
+            t = update_table(cand, changes, pw_map)
+        except ValueError:
+            t = None  # delta too large: full rebuild below
+    if t is None:
+        t = build_table(pub_bytes, powers)
     with _TABLE_LOCK:
         _TABLE_CACHE[key] = t
         while len(_TABLE_CACHE) > _TABLE_CACHE_MAX:
@@ -268,6 +486,60 @@ def base60_dev():
 
 
 # --------------------------------------------------------------------------
+# torsion candidates (the R-decompression-free ZIP-215 check)
+# --------------------------------------------------------------------------
+#
+# ZIP-215 validity  8([S]B) == 8R + 8([h]A)  is equivalent to
+#   exists T in E[8]:  W + T == decompress(R),  W := [S]B + [h](-A)
+# (the cofactor multiplication IS the 8-torsion quotient). Comparing the
+# eight candidates' AFFINE coordinates against the R encoding removes
+# both the per-signature sqrt chain of R's decompression (~250
+# sequential squarings) and the 3 cofactor doublings + in-kernel
+# canonical compares of the round-4 design:
+#   * y-compare is projective: y(C) == y_R  <=>  Y_C - y_R * Z_C == 0;
+#   * the sign bit needs affine x for ONE selected candidate, via a
+#     cross-lane Montgomery tree inversion (3 muls/lane amortized) in
+#     the XLA epilogue — impossible inside the kernel, nearly free
+#     outside it.
+# Candidate-set facts (differentially validated vs the oracle,
+# tests/test_ed25519_cached.py): at most 2 candidates can share y_R;
+# exactly 2 means an {x, -x} pair, which satisfies any sign bit; 1 means
+# the sign bit must match parity(x) (or x == 0, the ZIP-215 "-0" rule).
+
+
+@functools.lru_cache(maxsize=1)
+def _torsion_niels():
+    """The 7 non-identity E[8] points as niels limb tuples
+    ((y-x), (y+x), 2dxy), for const_col materialization in-kernel."""
+    pt = None
+    y = 2
+    while pt is None:
+        y += 1
+        cand, _ = ref.pt_decompress(int.to_bytes(y, 32, "little"))
+        if cand is None:
+            continue
+        t = ref.pt_mul(ref.L, cand)
+        if ref.pt_equal(ref.pt_mul(4, t), ref.IDENT):
+            continue  # order < 8: need a generator
+        pt = t
+    out = []
+    cur = pt
+    for _ in range(7):
+        zi = pow(cur[2], ref.P - 2, ref.P)
+        x, yv = cur[0] * zi % ref.P, cur[1] * zi % ref.P
+        ym = (yv - x) % ref.P
+        yp = (yv + x) % ref.P
+        t2d = 2 * ref.D * x * yv % ref.P
+        out.append(tuple(
+            tuple(int(v) for v in F25519.from_int(c))
+            for c in (ym, yp, t2d)
+        ))
+        cur = ref.pt_add(cur, pt)
+    assert ref.pt_equal(cur, ref.IDENT), "E[8] generator has wrong order"
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
 # the kernel
 # --------------------------------------------------------------------------
 
@@ -289,11 +561,32 @@ def _madd_rows(p, e, b):
     return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
 
 
-def _kernel(packed_ref, base_ref, ent_ref, valid_ref, s8_ref):
+def _sel16(ref, j: int, d_row):
+    """Per-lane 16-way entry select from an in-VMEM table block.
+
+    ref rows (e*64 + r) for entries e of base j at static offsets;
+    d_row (1, b) holds each lane's digit. A 4-level binary where-tree
+    (15 selects on (64, b) int16) beats both the 16-term one-hot
+    masked sum (31 ops) and the round-4 out-of-kernel MXU einsum
+    (which cost more in HBM traffic + transposes than the curve math
+    itself)."""
+    base = j * NENT * ROWS_PER_ENT
+    vals = [
+        ref[pl.ds(base + e * ROWS_PER_ENT, ROWS_PER_ENT), :]
+        for e in range(NENT)
+    ]
+    for k in range(4):
+        m = (d_row & (1 << k)) != 0  # (1, b)
+        vals = [
+            jnp.where(m, vals[2 * i + 1], vals[2 * i])
+            for i in range(len(vals) // 2)
+        ]
+    return vals[0]  # (64, b) int16
+
+
+def _kernel(packed_ref, base_ref, tab_ref, cand_ref, s8_ref, h4_ref):
     b = B_TILE
-    d_col = const_col(_D_T, b)
     d2_col = const_col(_D2_T, b)
-    sqrt_m1_col = const_col(_SQRT_M1_T, b)
 
     pk = packed_ref[:, :]  # (V_KROWS, b)
     ry2 = pk[V_RY:V_RY + 10]
@@ -302,22 +595,21 @@ def _kernel(packed_ref, base_ref, ent_ref, valid_ref, s8_ref):
     s8_ref[:, :] = jnp.concatenate(
         [(s8p >> (8 * k)) & 255 for k in range(4)], axis=0
     )  # (32, b) byte digits
-    flags = pk[V_FLAGS:V_FLAGS + 1]
-    rsign = flags & 1
-    pre = (flags >> 1) & 1
+    h4p = pk[V_H4:V_H4 + 8]
+    h4_ref[:, :] = jnp.concatenate(
+        [(h4p >> (4 * k)) & 15 for k in range(8)], axis=0
+    )  # (64, b) nibble digits; nibble t at row t
 
-    R, ok_r = decompress(ry, rsign, d_col, sqrt_m1_col)
-
-    # h*(-A): Horner over 8 window positions, 8 gathered entries each
-    # (fori_loop keeps the trace small; entry reads are dynamic ref
-    # slices with static sizes, which Mosaic supports).
+    # h*(-A): Horner over 8 window positions, 8 in-kernel-gathered
+    # entries each. Lane l of this tile is validator (i*128 + l) mod M,
+    # and tlo/thi_ref hold exactly table block (i mod M/128) via the
+    # BlockSpec index_map — so the entry fetch is a static-offset
+    # select, no HBM gather anywhere.
     def inner(pt, w):
-        # j unrolled: offsets stay 64-row aligned for any traced w
-        for j in range(NJ):
-            pt = _madd_rows(
-                pt, ent_ref[pl.ds((w * NJ + j) * ROWS_PER_ENT,
-                                  ROWS_PER_ENT), :], b
-            )
+        for j in range(NJ):  # nibble (8j + w) is base j's window-w digit
+            d_row = h4_ref[pl.ds(NW * j + w, 1), :]
+            ent = _sel16(tab_ref, j, d_row).astype(jnp.int32)
+            pt = _madd_rows(pt, ent, b)
         return pt
 
     def win_body(i, pt):
@@ -345,46 +637,48 @@ def _kernel(packed_ref, base_ref, ent_ref, valid_ref, s8_ref):
 
     sB = jax.lax.fori_loop(0, 32, base_body, pt_identity(b))
 
-    W = pt_add_noT(pt_add(sB, acc, d2_col), pt_neg(R), d2_col)
-    W8 = pt_double_p(pt_double_p(pt_double_p(W)))
-    eq = F.is_zero(W8[0]) & F.eq(W8[1], W8[2])  # (1, b)
-    valid = eq & ok_r & (pre != 0)
-    valid_ref[:, :] = valid.astype(jnp.int32)
+    W = pt_add(sB, acc, d2_col)
+
+    # torsion candidates C_i = W + T_i, T_i over E[8] (T_0 = identity).
+    # Emit, per candidate: ydiff = Y - y_R*Z (zero <=> y matches), X, Z
+    # — all raw (non-canonical) limbs; every compare, the sign-bit
+    # inversion and the validity boolean happen in the XLA epilogue
+    # (_verify_tally_cached) where cross-lane ops are cheap.
+    X, Y, Z, T = W
+    for i in range(8):
+        if i == 0:
+            Ci = (X, Y, Z)
+        else:
+            ym_t, yp_t, t2d_t = _torsion_niels()[i - 1]
+            ent = jnp.concatenate([
+                const_col(ym_t, b), const_col(yp_t, b),
+                const_col(t2d_t, b),
+            ], axis=0)
+            Ci = _madd_rows(W, ent, b)[:3]
+        # CAND_STRIDE slots keep every store 8-sublane aligned (20-row
+        # fields pad to 24; misaligned sublane stores cost relayouts)
+        cand_ref[pl.ds(i * CAND_STRIDE, NLIMBS), :] = F.sub(
+            Ci[1], F.mul(ry, Ci[2])
+        )
+        cand_ref[pl.ds(i * CAND_STRIDE + 24, NLIMBS), :] = Ci[0]
+        cand_ref[pl.ds(i * CAND_STRIDE + 48, NLIMBS), :] = Ci[2]
 
 
 @functools.partial(jax.jit, static_argnames=("n_commits",))
-def _verify_tally_cached(rows, t_lo, t_hi, ok, base, n_commits: int):
-    """Entry "gather" + Pallas verify + fused tally, one program.
+def _verify_tally_cached(rows, tab, ok, power5, base, n_commits: int):
+    """Pallas verify with in-kernel table blocks + fused tally.
 
-    The entry fetch is NOT a random gather (XLA TPU gathers run ~25 ms
-    for the 64 entries/sig a 16k batch needs — slower than the curve
-    math). Because vidx[b] == b mod M, lane l of tile t always reads
-    from table block (t mod M/128), so the fetch becomes a dense
-    per-(tile, lane) one-hot contraction over the 128-entry axis — two
-    exact bf16 matmuls on the MXU (limbs split lo8/hi5)."""
+    Because vidx[b] == b mod M, tile i's 128 lanes are exactly the
+    validators of table block (i mod M/128) — the whole block (2 MB
+    int16) streams into VMEM via the BlockSpec index_map and the
+    per-lane entry select happens inside the kernel. No gather, no
+    materialized entry tensor (the round-4 einsum design wrote+read
+    ~500 MB of HBM per 10k batch — more than the curve math cost)."""
     B = rows.shape[1]
     assert B % B_TILE == 0, f"B={B} not a multiple of {B_TILE}"
-    nt = B // 128
-    mt = t_lo.shape[0]  # table tiles (M/128)
-    vidx = jax.lax.broadcasted_iota(jnp.int32, (B,), 0) % (mt * 128)
-    h4p = rows[V_H4:V_H4 + 8]
-    dig = jnp.concatenate(
-        [(h4p >> (4 * k)) & 15 for k in range(8)], axis=0
-    )  # (64, B), row t = nibble t of h
-    digjw = dig.reshape(NJ, NW, B)  # nibble (8j + w) -> [j, w]
-    E = (jnp.arange(NJ) * NENT)[:, None, None] + digjw  # (j, w, B)
-    Eb = E.transpose(1, 0, 2).reshape(NW * NJ, nt, 128)  # (wj, t, l)
-    oh = (Eb[..., None] == jnp.arange(NJ * NENT)).astype(jnp.int8)
-    oh = oh.transpose(1, 2, 0, 3)  # (t, l, wj, E)
-    tsel = jnp.arange(nt) % mt
-    lo_t = jnp.take(t_lo, tsel, axis=0) if mt != nt else t_lo
-    hi_t = jnp.take(t_hi, tsel, axis=0) if mt != nt else t_hi
-    lo = jnp.einsum("tlwE,tlEm->tlwm", oh, lo_t,
-                    preferred_element_type=jnp.int32)
-    hi = jnp.einsum("tlwE,tlEm->tlwm", oh, hi_t,
-                    preferred_element_type=jnp.int32)
-    out_e = lo + (hi << 7)
-    ent = out_e.transpose(2, 3, 0, 1).reshape(NW * NJ * ROWS_PER_ENT, B)
+    mt = tab.shape[0] // ENT_BLOCK  # table blocks (M/128)
+    M = mt * 128
+    vidx = jax.lax.broadcasted_iota(jnp.int32, (B,), 0) % M
 
     grid = (B // B_TILE,)
     col = lambda r: pl.BlockSpec(
@@ -394,30 +688,60 @@ def _verify_tally_cached(rows, t_lo, t_hi, ok, base, n_commits: int):
         (32 * 256, ROWS_PER_ENT), lambda i: (0, 0),
         memory_space=pltpu.VMEM,
     )
-    out = pl.pallas_call(
+    tblock = pl.BlockSpec(
+        (ENT_BLOCK, 128), lambda i: (i % mt, 0),
+        memory_space=pltpu.VMEM,
+    )
+    cand = pl.pallas_call(
         _kernel,
         interpret=(jax.default_backend() == "cpu"),
-        out_shape=jax.ShapeDtypeStruct((1, B), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((8 * CAND_STRIDE, B), jnp.int32),
         grid=grid,
-        in_specs=[col(V_KROWS), full, col(NW * NJ * ROWS_PER_ENT)],
-        out_specs=col(1),
+        in_specs=[col(V_KROWS), full, tblock],
+        out_specs=col(8 * CAND_STRIDE),
         scratch_shapes=[
             pltpu.VMEM((32, B_TILE), jnp.int32),  # s byte digits
+            pltpu.VMEM((64, B_TILE), jnp.int32),  # h nibble digits
         ],
-    )(rows[:V_KROWS], base, ent)
-    valid = (out[0] != 0) & jnp.take(ok, vidx, axis=0)
+    )(rows[:V_KROWS], base, tab)
 
-    pw = rows[V_POW:V_POW + 3]
-    power5 = jnp.stack(
-        [pw[0] & _M13, pw[0] >> 13, pw[1] & _M13, pw[1] >> 13, pw[2]],
-        axis=1,
-    )
+    # XLA epilogue: candidate compares + the sign bit. ONE wide
+    # canonical pass decides y-matches and x==0 for all 8 candidates
+    # (16B lanes side by side); the selected candidate's affine x comes
+    # from a Montgomery-tree batched inversion (~3 muls/lane) — the
+    # whole epilogue replaces the kernel's per-lane ~250-squaring R
+    # decompression of rounds 2-4.
+    cs = CAND_STRIDE
+    ydiffs = [cand[i * cs:i * cs + NLIMBS] for i in range(8)]
+    Xs = [cand[i * cs + 24:i * cs + 24 + NLIMBS] for i in range(8)]
+    Zs = [cand[i * cs + 48:i * cs + 48 + NLIMBS] for i in range(8)]
+    wide = F.canonical(jnp.concatenate(ydiffs + Xs, axis=1))
+    zflags = jnp.all(wide == 0, axis=0)  # (16B,)
+    ymatch = zflags[:8 * B].reshape(8, B)
+    xzero = zflags[8 * B:].reshape(8, B)
+    nmatch = ymatch.sum(axis=0)  # (B,) in {0, 1, 2}
+    msk = ymatch[:, None, :]
+    Xsel = sum(jnp.where(msk[i], Xs[i], 0) for i in range(8))
+    Zsel = sum(jnp.where(msk[i], Zs[i], 0) for i in range(8))
+    xzero_sel = jnp.any(ymatch & xzero, axis=0)  # (B,)
+    one_col = jnp.zeros((NLIMBS, B), jnp.int32).at[0].set(1)
+    Zsafe = jnp.where(nmatch[None, :] == 1, Zsel, one_col)
+    par = F.parity(F.mul(Xsel, F.batch_inv(Zsafe)))[0]  # (B,)
+    rsign = rows[V_FLAGS] & 1
+    pre = (rows[V_FLAGS] >> 1) & 1
+    sign_ok = xzero_sel | (par == rsign)
+    eq = (nmatch == 2) | ((nmatch == 1) & sign_ok)
+    valid = eq & (pre != 0) & jnp.take(ok, vidx, axis=0)
+
+    # power comes from the valset table: row b is validator b mod M
+    reps = -(-B // M)
+    pw = jnp.tile(power5, (reps, 1))[:B]
     counted = (rows[V_FLAGS] >> 2) & 1 != 0
     commit_ids = rows[V_FLAGS] >> 3
     thresh = rows[V_THRESH:].reshape(-1)[
         : n_commits * ek.TALLY_LIMBS
     ].reshape(n_commits, ek.TALLY_LIMBS)
-    tally = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
+    tally = ek.tally_core(valid, pw, counted, commit_ids, n_commits)
     return valid, tally, ek.quorum_core(tally, thresh)
 
 
@@ -426,14 +750,15 @@ def _verify_tally_cached(rows, t_lo, t_hi, ok, base, n_commits: int):
 # --------------------------------------------------------------------------
 
 
-def pack_rows_cached(pb, power5=None, counted=None,
-                     commit_ids=None, thresh=None) -> np.ndarray:
+def pack_rows_cached(pb, counted=None, commit_ids=None,
+                     thresh=None) -> np.ndarray:
     """PackedBatch -> one compact (R, B) int32 array for the cached path.
 
     Same single-transfer philosophy as ed25519_pallas.pack_rows, minus
-    the 10 pubkey rows (the device table replaces them) and any index
-    row (row b's validator is b mod M by construction — callers MUST lay
-    commits out in valset order padded to the table stride)."""
+    the 10 pubkey rows (the device table replaces them), any index row
+    (row b's validator is b mod M by construction — callers MUST lay
+    commits out in valset order padded to the table stride), and the
+    power rows (valset data, carried by the table)."""
     B = pb.ry.shape[0]
     if thresh is None:
         thresh = np.zeros((1, ek.TALLY_LIMBS), np.int32)
@@ -459,20 +784,15 @@ def pack_rows_cached(pb, power5=None, counted=None,
     if commit_ids is not None:
         flags = flags | (np.asarray(commit_ids, np.int32) << 3)
     rows[V_FLAGS] = flags
-    if power5 is not None:
-        p = np.asarray(power5, np.int32)
-        rows[V_POW] = p[:, 0] | (p[:, 1] << 13)
-        rows[V_POW + 1] = p[:, 2] | (p[:, 3] << 13)
-        rows[V_POW + 2] = p[:, 4]
     flat = rows[V_THRESH:].reshape(-1)
     flat[: tvals.size] = tvals
     return rows
 
 
 def verify_tally_rows_cached(rows, table: ValsetTable, n_commits: int):
-    """Fused gather+verify+tally from one packed (R, B) array."""
-    return _verify_tally_cached(rows, table.t_lo, table.t_hi, table.ok,
-                                base60_dev(), n_commits)
+    """Fused verify+tally from one packed (R, B) array."""
+    return _verify_tally_cached(rows, table.tab, table.ok,
+                                table.power5, base60_dev(), n_commits)
 
 
 def pad_rows(n: int) -> int:

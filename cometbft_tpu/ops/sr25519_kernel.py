@@ -242,28 +242,43 @@ def batch_challenges(msgs, pubs, r_encs) -> np.ndarray:
     the nibble pack). Groups rows by len(msg): within a group the
     transcript op sequence is identical, so the batched STROBE applies.
     """
+    from cometbft_tpu import native
+
     n = len(msgs)
     out = np.zeros((n, 64), np.uint8)
     prefix = sr._signing_prefix()
     groups = {}
     for i, m in enumerate(msgs):
         groups.setdefault(len(m), []).append(i)
+    use_native = native.available()
     for ln, idxs in groups.items():
-        bt = merlin.BatchTranscript(len(idxs), prefix)
         marr = np.frombuffer(
             b"".join(msgs[i] for i in idxs), np.uint8
         ).reshape(len(idxs), ln) if ln else np.empty((len(idxs), 0), np.uint8)
-        bt.append_message_batch(b"sign-bytes", marr)
-        bt.append_message_shared(b"proto-name", b"Schnorr-sig")
         parr = np.frombuffer(
             b"".join(pubs[i] for i in idxs), np.uint8
         ).reshape(len(idxs), 32)
-        bt.append_message_batch(b"sign:pk", parr)
         rarr = np.frombuffer(
             b"".join(r_encs[i] for i in idxs), np.uint8
         ).reshape(len(idxs), 32)
-        bt.append_message_batch(b"sign:R", rarr)
-        ch = bt.challenge_bytes_batch(b"sign:c", 64)
+        ch = None
+        if use_native and ln > 0:
+            # whole transcripts in one C call (the numpy BatchStrobe
+            # below paid ~70 ms of python/numpy op dispatch per 5k-row
+            # commit — the round-4 cfg3 host bottleneck); BatchStrobe
+            # stays as the differential reference (tests/test_native)
+            s = prefix.strobe
+            ch = native.sr25519_batch_challenges(
+                bytes(s.st), s.pos, s.pos_begin, s.cur_flags,
+                marr, parr, rarr,
+            )
+        if ch is None:
+            bt = merlin.BatchTranscript(len(idxs), prefix)
+            bt.append_message_batch(b"sign-bytes", marr)
+            bt.append_message_shared(b"proto-name", b"Schnorr-sig")
+            bt.append_message_batch(b"sign:pk", parr)
+            bt.append_message_batch(b"sign:R", rarr)
+            ch = bt.challenge_bytes_batch(b"sign:c", 64)
         out[np.asarray(idxs)] = ch
     return out
 

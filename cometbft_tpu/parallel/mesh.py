@@ -126,3 +126,41 @@ def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
         put(pb.hdig), put(pb.precheck), put(power5), put(counted),
         put(commit_ids),
     )
+
+
+def sharded_stream_verify(mesh: Mesh, n_commits: int):
+    """The blocksync STREAMING path (cached-valset kernel) under
+    shard_map: a multi-commit chunk shards at COMMIT granularity.
+
+    Layout contract (blocksync/pipeline.py _pack_chunk_cached): commit c
+    occupies rows [c*M, (c+1)*M) with validator i at row c*M + i. The
+    rows array (R, C*M) shards on its lane axis so each device holds
+    C/n_dev whole commits — the per-device slice width stays a multiple
+    of M, which keeps the kernel's `row mod M -> validator` and
+    `tile mod M/128 -> table block` maps intact without any index
+    plumbing. The valset table replicates (it is the same valset for
+    every commit — the streaming shape, blocksync/reactor.go:463); rows
+    carry GLOBAL commit ids, so each device's partial tally lands in
+    the right commit slot and one psum over the mesh finishes every
+    commit's quorum at once.
+    """
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    axis = mesh.axis_names[0]
+
+    def step(rows, tab, ok, power5, base, threshold):
+        valid, local, _ = ec._verify_tally_cached.__wrapped__(
+            rows, tab, ok, power5, base, n_commits
+        )
+        total = _carry_tally(jax.lax.psum(local, axis))
+        quorum = ek.quorum_core(total, threshold)
+        return valid, total, quorum
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(), P(), P(), P(), P()),
+        out_specs=(P(axis), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

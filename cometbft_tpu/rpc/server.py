@@ -290,6 +290,8 @@ class Routes:
         from cometbft_tpu.abci import types as abci
 
         want_proof = prove in (True, "true", "1", 1)
+        if isinstance(data, str) and data.startswith("0x"):
+            data = data[2:]  # URI form carries 0x-prefixed hex
         resp = self.node.app_conns.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path or "",
@@ -485,6 +487,47 @@ class Routes:
         txs = self.node.mempool.reap(-1)
         return {"n_txs": len(txs), "total": len(txs)}
 
+    # -- unsafe ops routes (rpc/core/routes.go:58-63, behind the
+    # config's `unsafe` flag like the reference's --rpc.unsafe) --------------
+
+    def _addrs_arg(self, lst):
+        from cometbft_tpu.p2p.key import NetAddress
+
+        out = []
+        for s in lst:
+            nid, _, hostport = s.partition("@")
+            host, _, port = hostport.rpartition(":")
+            out.append(NetAddress(nid, host or "127.0.0.1", int(port)))
+        return out
+
+    def dial_seeds(self, seeds=None):
+        """rpc/core/net.go UnsafeDialSeeds."""
+        if self.node.switch is None:
+            raise RPCError(-32603, "p2p is disabled")
+        if isinstance(seeds, str):
+            seeds = json.loads(seeds)
+        for a in self._addrs_arg(seeds or []):
+            self.node.switch.dial_peer(a, persistent=False)
+        return {"log": f"dialing seeds in progress: {seeds}"}
+
+    def dial_peers(self, peers=None, persistent=False,
+                   unconditional=False, private=False):
+        """rpc/core/net.go UnsafeDialPeers."""
+        if self.node.switch is None:
+            raise RPCError(-32603, "p2p is disabled")
+        if isinstance(peers, str):
+            peers = json.loads(peers)
+        if isinstance(persistent, str):
+            persistent = persistent.lower() == "true"
+        for a in self._addrs_arg(peers or []):
+            self.node.switch.dial_peer(a, persistent=bool(persistent))
+        return {"log": f"dialing peers in progress: {peers}"}
+
+    def unsafe_flush_mempool(self):
+        """rpc/core/mempool.go UnsafeFlushMempool."""
+        self.node.mempool.flush()
+        return {}
+
 
 _ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
@@ -496,6 +539,10 @@ _ROUTES = [
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search",
 ]
+
+# only served when the server runs with unsafe=True
+# (routes.go:58-63 AddUnsafeRoutes)
+_UNSAFE_ROUTES = ["dial_seeds", "dial_peers", "unsafe_flush_mempool"]
 
 
 # --------------------------------------------------------------------------
@@ -558,7 +605,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _call(self, method: str, params: dict, rid):
-        if method not in _ROUTES:
+        unsafe_on = getattr(self.server, "unsafe", False)
+        if method in _UNSAFE_ROUTES and not unsafe_on:
+            self._reply_error(
+                -32601,
+                f"{method!r} requires the RPC server's unsafe flag "
+                f"(rpc/core/routes.go AddUnsafeRoutes)", rid,
+            )
+            return
+        if method not in _ROUTES and method not in _UNSAFE_ROUTES:
             self._reply_error(-32601, f"method {method!r} not found", rid)
             return
         try:
@@ -587,11 +642,98 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if url.path.startswith("/debug/pprof"):
+            # profiling endpoints (node/node.go:867-881 pprof server +
+            # rpc/core/dev.go unsafe profiling): Python analogs —
+            # thread stack dump, CPU profile, heap profile. Gated by
+            # the same unsafe flag as the ops routes.
+            if not getattr(self.server, "unsafe", False):
+                self._reply_error(
+                    -32601, "profiling requires the unsafe flag",
+                    http=403)
+                return
+            self._pprof(url)
+            return
         method = url.path.strip("/")
         params = dict(parse_qsl(url.query))
         # URI params arrive quoted like the reference's URI form
         params = {k: v.strip('"') for k, v in params.items()}
         self._call(method, params, -1)
+
+    def _pprof(self, url):
+        import io
+        from urllib.parse import parse_qsl as _pq
+
+        q = dict(_pq(url.query))
+        kind = url.path[len("/debug/pprof"):].strip("/") or "index"
+        body = b""
+        if kind in ("goroutine", "threads", "stacks"):
+            import sys as _sys
+            import traceback
+
+            buf = io.StringIO()
+            frames = _sys._current_frames()
+            for t in threading.enumerate():
+                buf.write(f"thread {t.name} (daemon={t.daemon})\n")
+                fr = frames.get(t.ident)
+                if fr:
+                    traceback.print_stack(fr, file=buf)
+                buf.write("\n")
+            body = buf.getvalue().encode()
+        elif kind == "profile":
+            import cProfile
+            import pstats
+
+            seconds = min(float(q.get("seconds", 2)), 30.0)
+            pr = cProfile.Profile()
+            pr.enable()
+            time.sleep(seconds)  # samples THIS thread + enabled scope
+            pr.disable()
+            buf = io.StringIO()
+            pstats.Stats(pr, stream=buf).sort_stats("cumulative") \
+                .print_stats(60)
+            body = buf.getvalue().encode()
+        elif kind == "heap":
+            import tracemalloc
+
+            trace = q.get("trace", "")
+            if trace == "start" and not tracemalloc.is_tracing():
+                tracemalloc.start()
+                body = b"tracemalloc tracing started\n"
+            elif trace == "stop" and tracemalloc.is_tracing():
+                tracemalloc.stop()
+                body = b"tracemalloc tracing stopped\n"
+            elif tracemalloc.is_tracing():
+                snap = tracemalloc.take_snapshot()
+                buf = io.StringIO()
+                for st in snap.statistics("lineno")[:60]:
+                    buf.write(f"{st}\n")
+                body = buf.getvalue().encode()
+            else:
+                # one-shot heap overview with NO standing overhead:
+                # object counts by type (tracemalloc only sees allocs
+                # made after start(), so a first-call start would hand
+                # incident collectors an empty snapshot while taxing
+                # the node forever; opt in via ?trace=start)
+                import gc
+                from collections import Counter
+
+                counts = Counter(type(o).__name__
+                                 for o in gc.get_objects())
+                buf = io.StringIO()
+                buf.write("live objects by type (gc view; pass "
+                          "?trace=start for tracemalloc)\n")
+                for name, cnt in counts.most_common(60):
+                    buf.write(f"{cnt:10d}  {name}\n")
+                body = buf.getvalue().encode()
+        else:
+            body = (b"pprof-analog endpoints: /debug/pprof/goroutine "
+                    b"/debug/pprof/profile?seconds=N /debug/pprof/heap\n")
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_POST(self):
         length = int(self.headers.get("Content-Length", 0))
@@ -734,10 +876,15 @@ _CLOSED = object()
 class RPCServer:
     """rpc/jsonrpc server lifecycle wrapper."""
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
+                 unsafe: bool = False):
         self.node = node
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.routes = Routes(node)  # type: ignore[attr-defined]
+        # serves dial_seeds/dial_peers/unsafe_flush_mempool + the
+        # /debug/pprof endpoints (routes.go:58 AddUnsafeRoutes,
+        # rpc/core/dev.go) only when set
+        self.httpd.unsafe = unsafe  # type: ignore[attr-defined]
         self.httpd.stopping = False  # type: ignore[attr-defined]
         self.httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

@@ -236,10 +236,14 @@ class IndexerService:
     (state/txindex/indexer_service.go)."""
 
     def __init__(self, event_bus, tx_indexer: TxIndexer,
-                 block_indexer: BlockIndexer):
+                 block_indexer: BlockIndexer, extra_sinks=None):
         self.bus = event_bus
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
+        # additional event sinks (state/txindex/indexer_service.go
+        # supports kv + psql simultaneously); each gets the same
+        # index_tx_events/index_block_events feed as the kv pair
+        self.extra_sinks = list(extra_sinks or [])
         self._sub_tx = event_bus.subscribe(
             "indexer", "tm.event='Tx'", capacity=1000
         )
@@ -262,15 +266,23 @@ class IndexerService:
                 idx = counters.get(h, 0)
                 counters[h] = idx + 1
                 self.tx_indexer.index(h, idx, d["tx"], d["result"])
+                for s in self.extra_sinks:
+                    try:
+                        s.index_tx_events(h, idx, d["tx"], d["result"])
+                    except Exception:  # noqa: BLE001 - sink is aux
+                        pass
                 msg = self._sub_tx.next(timeout=0)
             msg = self._sub_blk.next(timeout=0)
             while msg is not None:
                 blk = msg.data["block"]
-                self.block_indexer.index(
-                    blk.header.height,
-                    {"block.proposer":
-                        [blk.header.proposer_address.hex().upper()]},
-                )
+                tags = {"block.proposer":
+                        [blk.header.proposer_address.hex().upper()]}
+                self.block_indexer.index(blk.header.height, tags)
+                for s in self.extra_sinks:
+                    try:
+                        s.index_block_events(blk.header.height, tags)
+                    except Exception:  # noqa: BLE001 - sink is aux
+                        pass
                 msg = self._sub_blk.next(timeout=0)
 
     def stop(self) -> None:

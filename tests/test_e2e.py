@@ -103,6 +103,33 @@ class Testnet:
             p.wait(timeout=30)
             self.procs[i] = None
 
+    def pause_node(self, i: int) -> None:
+        """perturb.go: pause (docker pause -> SIGSTOP here): the
+        process freezes mid-whatever; peers see silence, not a
+        closed socket."""
+        p = self.procs.get(i)
+        assert p is not None
+        os.kill(p.pid, signal.SIGSTOP)
+
+    def resume_node(self, i: int) -> None:
+        p = self.procs.get(i)
+        assert p is not None
+        os.kill(p.pid, signal.SIGCONT)
+
+    def privval_key(self, i: int):
+        """The node's consensus signing key (for evidence forging,
+        runner/evidence.go reads exactly this file)."""
+        from cometbft_tpu.privval.file_pv import FilePV
+
+        return FilePV.load(
+            os.path.join(self.root, f"node{i}", "config")
+        ).priv_key
+
+    def genesis(self, i: int = 0) -> dict:
+        with open(os.path.join(self.root, f"node{i}", "config",
+                               "genesis.json")) as f:
+            return json.load(f)
+
     def stop(self) -> None:
         for i, p in self.procs.items():
             if p is not None and p.poll() is None:
@@ -197,3 +224,139 @@ def test_e2e_basic_and_kill_restart(tmp_path):
                     tail = f.read()[-800:]
                 print(f"--- node{i} log tail ---\n"
                       f"{tail.decode(errors='replace')}")
+
+
+@pytest.mark.slow
+def test_e2e_perturbation_matrix(tmp_path):
+    """perturb.go:44-60 matrix on a 5-validator net: pause (brief
+    SIGSTOP — peers see silence), disconnect (long SIGSTOP — peer
+    connections drop and must re-establish), kill+restart. After each
+    perturbation the chain keeps committing and the perturbed node
+    catches back up; at the end all five agree on every block hash."""
+    m = Manifest(validators=5, chain_id="e2e-perturb",
+                 perturbations=["pause:1", "disconnect:2", "kill:3",
+                                "restart:3"])
+    net = Testnet(m, str(tmp_path / "net"))
+    net.start()
+    try:
+        net.wait_for_height(2, timeout=240)
+        others = [0, 2, 3, 4]
+
+        # pause: freeze node 1 for a few seconds; quorum (4/5) holds
+        net.pause_node(1)
+        h = max(net.height(i) for i in others)
+        net.wait_for_height(h + 2, nodes=others, timeout=180)
+        net.resume_node(1)
+        net.wait_for_height(max(net.height(i) for i in others),
+                            nodes=[1], timeout=180)
+
+        # disconnect: freeze node 2 long enough that its TCP peers
+        # drop it (send/recv stall -> peer error), then resume; it
+        # must redial and catch up
+        net.pause_node(2)
+        time.sleep(12)
+        others = [0, 1, 3, 4]
+        h = max(net.height(i) for i in others)
+        net.wait_for_height(h + 2, nodes=others, timeout=180)
+        net.resume_node(2)
+        net.wait_for_height(max(net.height(i) for i in others),
+                            nodes=[2], timeout=240)
+
+        # kill + restart (the round-4 scenario, now at 5 validators)
+        net.kill_node(3)
+        others = [0, 1, 2, 4]
+        h = max(net.height(i) for i in others)
+        net.wait_for_height(h + 2, nodes=others, timeout=180)
+        net.start_node(3)
+        net.wait_for_height(max(net.height(i) for i in others) + 1,
+                            timeout=240)
+
+        net.assert_blocks_agree(3)
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_e2e_byzantine_evidence_committed(tmp_path):
+    """runner/evidence.go: forge DuplicateVoteEvidence with a real
+    validator's key (two conflicting precommits at a past height),
+    submit over public RPC, and require it to land inside a committed
+    block which every node agrees on — the full byzantine pipeline
+    pool -> gossip -> proposal -> commit, multi-process."""
+    sys.path.insert(0, REPO)
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.evidence import (
+        DuplicateVoteEvidence,
+        evidence_to_j,
+    )
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.vote import Vote
+
+    m = Manifest(validators=5, chain_id="e2e-byz")
+    net = Testnet(m, str(tmp_path / "net"))
+    net.start()
+    try:
+        net.wait_for_height(3, timeout=240)
+
+        # the byzantine double-signer: validator 4's real key
+        priv = net.privval_key(4)
+        addr = priv.pub_key().address()
+        gen = net.genesis()
+        from cometbft_tpu.crypto.keys import PubKey
+        from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+        vset = ValidatorSet([
+            Validator(PubKey(bytes.fromhex(v["pub_key"]["value"]),
+                             v["pub_key"]["type"]), int(v["power"]))
+            for v in gen["validators"]
+        ])
+        vidx, val = vset.get_by_address(addr)
+        power = val.voting_power
+        total = vset.total_voting_power()
+
+        ev_h = 2  # a committed past height (valset known everywhere)
+        now = Timestamp(int(time.time()), 0)
+
+        def vote(tag):
+            bid = BlockID(tag * 32, PartSetHeader(1, tag * 32))
+            v = Vote(
+                vote_type=canonical.PRECOMMIT_TYPE, height=ev_h,
+                round=0, block_id=bid, timestamp=now,
+                validator_address=addr, validator_index=vidx,
+            )
+            v.signature = priv.sign(v.sign_bytes(m.chain_id))
+            return v
+
+        ev = DuplicateVoteEvidence.from_votes(
+            vote(b"\xaa"), vote(b"\xbb"), now, total, power
+        )
+        r = net.rpc(0, "broadcast_evidence",
+                    evidence=evidence_to_j(ev))
+        assert r["hash"]
+
+        # the evidence must appear inside a committed block
+        deadline = time.time() + 180
+        found_at = None
+        scanned = 3
+        while time.time() < deadline and found_at is None:
+            head = net.height(0)
+            for h in range(scanned, head + 1):
+                blk = net.rpc(0, "block", height=h)["block"]
+                evs = blk.get("evidence") or []
+                if any(e.get("t") == "duplicate_vote" for e in evs):
+                    found_at = h
+                    break
+            scanned = max(scanned, head)
+            time.sleep(0.5)
+        assert found_at is not None, "evidence never committed"
+        # every node sees the same evidence block (gossip + agreement)
+        net.wait_for_height(found_at, timeout=120)
+        for i in range(5):
+            blk = net.rpc(i, "block", height=found_at)["block"]
+            evs = blk.get("evidence") or []
+            assert any(e.get("t") == "duplicate_vote" for e in evs)
+        # and the chain keeps going after punishing its validator
+        net.wait_for_height(found_at + 2, timeout=120)
+    finally:
+        net.stop()

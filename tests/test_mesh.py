@@ -84,3 +84,53 @@ def test_sharded_pallas_rows():
     np.testing.assert_array_equal(np.asarray(valid)[:n], exp)
     assert k.tally_to_int(np.asarray(tally))[0] == int(powers.sum()) - 20
     assert bool(np.asarray(quorum)[0])
+
+
+def test_sharded_stream_cached_multi_commit():
+    """The blocksync streaming shape multi-device: a 16-commit chunk of
+    one 128-validator valset through the cached-table kernel, sharded
+    2 commits/device over the 8-mesh, per-commit psum tallies; one bad
+    signature flips exactly its commit's row and no quorum bit (each
+    commit has 128/128 power, so one loss still clears 2/3)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+    from cometbft_tpu.parallel import mesh as pm
+
+    mesh = pm.make_mesh(jax.devices()[:8])
+    n_commits = 16
+    keys = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(128)]
+    pubs = [k.pub_key().data for k in keys]
+    table = ec.build_table(pubs, [10] * 128)
+    M = table.n_vals
+    B = n_commits * M
+    spubs, smsgs, ssigs = [], [], []
+    for c in range(n_commits):
+        for i, k in enumerate(keys):
+            m = b"mesh-stream-%d-%d" % (c, i)
+            spubs.append(pubs[i])
+            smsgs.append(m)
+            ssigs.append(k.sign(m))
+    bad = 5 * M + 17  # commit 5, validator 17
+    ssigs[bad] = b"\x01" * 64
+    pb = ek.pack_batch(spubs, smsgs, ssigs, pad_to=B)
+    counted = np.ones((B,), np.bool_)
+    cids = np.repeat(np.arange(n_commits, dtype=np.int32), M)
+    thresh = ek.threshold_limbs(128 * 10 * 2 // 3, n_commits)
+    rows = ec.pack_rows_cached(pb, counted, cids, thresh)
+    step = pm.sharded_stream_verify(mesh, n_commits)
+    rows_d = jax.device_put(
+        rows, NamedSharding(mesh, P(None, mesh.axis_names[0])))
+    valid, tally, quorum = jax.block_until_ready(
+        step(rows_d, table.tab, table.ok, table.power5,
+             ec.base60_f32(), thresh))
+    v = np.asarray(valid)
+    assert not v[bad] and v.sum() == B - 1
+    t = ek.tally_to_int(np.asarray(tally))
+    assert int(t[5]) == 127 * 10
+    assert all(int(t[c]) == 128 * 10 for c in range(n_commits) if c != 5)
+    assert np.asarray(quorum).all()

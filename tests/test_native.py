@@ -173,3 +173,44 @@ def test_batch_keccak_f1600_differential(have_native):
     np.testing.assert_array_equal(
         native.batch_keccak_f1600(z), keccak_f1600_np(z.copy())
     )
+
+
+def test_native_sr25519_challenges_match_batchstrobe():
+    """The C transcript walker is byte-identical to the numpy
+    BatchStrobe route AND the scalar reference transcripts, across
+    message lengths (incl. rate-crossing >166-byte messages)."""
+    import numpy as np
+
+    from cometbft_tpu import native
+    from cometbft_tpu.crypto import merlin
+    from cometbft_tpu.crypto import sr25519_ref as sr
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(3)
+    for ln in (1, 32, 110, 166, 167, 400):
+        n = 17
+        msgs = rng.integers(0, 256, (n, ln), dtype=np.uint8)
+        pks = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        rs = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        prefix = sr._signing_prefix()
+        s = prefix.strobe
+        got = native.sr25519_batch_challenges(
+            bytes(s.st), s.pos, s.pos_begin, s.cur_flags, msgs, pks, rs)
+        # numpy batch route
+        bt = merlin.BatchTranscript(n, prefix)
+        bt.append_message_batch(b"sign-bytes", msgs)
+        bt.append_message_shared(b"proto-name", b"Schnorr-sig")
+        bt.append_message_batch(b"sign:pk", pks)
+        bt.append_message_batch(b"sign:R", rs)
+        exp = bt.challenge_bytes_batch(b"sign:c", 64)
+        np.testing.assert_array_equal(got, exp)
+        # scalar reference for row 0
+        t = prefix.clone()
+        t.append_message(b"sign-bytes", msgs[0].tobytes())
+        t.append_message(b"proto-name", b"Schnorr-sig")
+        t.append_message(b"sign:pk", pks[0].tobytes())
+        t.append_message(b"sign:R", rs[0].tobytes())
+        assert t.challenge_bytes(b"sign:c", 64) == got[0].tobytes()

@@ -1,0 +1,109 @@
+"""psql event sink: schema parity with state/indexer/sink/psql.
+
+The reference contract: blocks/tx_results/events/attributes tables +
+event_attributes/block_events/tx_events views; search is NOT served by
+the sink (reads are plain SQL). Runs on the sqlite dialect shim (no
+postgres server in the image) — the SQL text and table/view names are
+the schema.sql ones.
+"""
+import hashlib
+
+import pytest
+
+from cometbft_tpu.abci.types import ExecTxResult
+from cometbft_tpu.state.psql_sink import PsqlEventSink, PsqlSinkError
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    s = PsqlEventSink.sqlite(str(tmp_path / "sink.db"), "psql-chain")
+    yield s
+    s.close()
+
+
+def test_tx_events_schema_parity(sink):
+    tx = b"k=v"
+    res = ExecTxResult(code=0, data=b"\x01", log="ok")
+    sink.index_tx_events(3, 0, tx, res,
+                         {"transfer.amount": ["100"],
+                          "transfer.sender": ["alice"]})
+    cur = sink.conn.cursor()
+    # blocks row (height, chain_id) unique
+    rows = cur.execute(
+        "SELECT height, chain_id FROM blocks").fetchall()
+    assert rows == [(3, "psql-chain")]
+    # tx_results row with hex hash + result payload
+    h = hashlib.sha256(tx).hexdigest().upper()
+    rows = cur.execute(
+        'SELECT "index", tx_hash FROM tx_results').fetchall()
+    assert rows == [(0, h)]
+    # the tx_events VIEW joins blocks + tx_results + attributes
+    got = dict(
+        (ck, v) for (ck, v) in cur.execute(
+            "SELECT composite_key, value FROM tx_events "
+            "WHERE height = 3").fetchall()
+    )
+    assert got["tx.height"] == "3"
+    assert got["tx.hash"] == h
+    assert got["transfer.amount"] == "100"
+    assert got["transfer.sender"] == "alice"
+    # attributes carry split (type, key) like abci events
+    t = cur.execute(
+        "SELECT type FROM events WHERE tx_id IS NOT NULL "
+        "AND type='transfer'").fetchall()
+    assert t, "event type not split from composite key"
+
+    # re-index of the same (block, index) is a no-op (upsert)
+    sink.index_tx_events(3, 0, tx, res)
+    assert cur.execute(
+        "SELECT COUNT(*) FROM tx_results").fetchone()[0] == 1
+
+
+def test_block_events_view_and_search_unsupported(sink):
+    sink.index_block_events(7, {"block.proposer": ["AA" * 20]})
+    cur = sink.conn.cursor()
+    got = dict(cur.execute(
+        "SELECT composite_key, value FROM block_events "
+        "WHERE height = 7").fetchall())
+    assert got["block.height"] == "7"
+    assert got["block.proposer"] == "AA" * 20
+    # block events have tx_id NULL by definition of the view
+    assert cur.execute(
+        "SELECT COUNT(*) FROM events WHERE tx_id IS NULL"
+    ).fetchone()[0] >= 1
+    with pytest.raises(PsqlSinkError):
+        sink.search("tx.height=7")
+
+
+def test_indexer_service_feeds_extra_sink(tmp_path):
+    """IndexerService fans out to the psql sink alongside the kv
+    indexers (txindex/indexer_service.go multi-sink)."""
+    import time
+
+    from cometbft_tpu.state.indexer import (
+        BlockIndexer,
+        IndexerService,
+        TxIndexer,
+    )
+    from cometbft_tpu.types.event_bus import EventBus
+
+    bus = EventBus()
+    sink = PsqlEventSink.sqlite(str(tmp_path / "s.db"), "svc-chain")
+    svc = IndexerService(bus, TxIndexer(), BlockIndexer(),
+                         extra_sinks=[sink])
+    try:
+        bus.publish_tx(5, b"a=1", ExecTxResult(code=0, data=b"", log=""))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sink.conn.cursor().execute(
+                    "SELECT COUNT(*) FROM tx_results").fetchone()[0]:
+                break
+            time.sleep(0.05)
+        cur = sink.conn.cursor()
+        assert cur.execute(
+            "SELECT COUNT(*) FROM tx_results").fetchone()[0] == 1
+        assert cur.execute(
+            "SELECT height FROM blocks").fetchone()[0] == 5
+    finally:
+        svc.stop()
+        sink.close()

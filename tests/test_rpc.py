@@ -326,3 +326,70 @@ def test_pruner_retention(rpc_node):
     # the chain keeps running after pruning
     h = node.height()
     assert node.consensus.wait_for_height(h + 2, timeout=60)
+
+
+def test_unsafe_routes_gated_and_working(tmp_path):
+    """dial_seeds/dial_peers/unsafe_flush_mempool + /debug/pprof only
+    exist behind the unsafe flag (rpc/core/routes.go:58-63,
+    rpc/core/dev.go); with it, they act on the node."""
+    import json as _json
+    import urllib.request
+
+    priv = PrivKey.generate(b"\x0a" * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    state = State.make_genesis("unsafe-chain", vals)
+    node = Node(KVStoreApplication(), state, privval=FilePV(priv),
+                home=str(tmp_path / "n0"), timeouts=FAST, p2p=True)
+    node.listen()
+    node.start()
+    safe_url = node.rpc_listen()
+    from cometbft_tpu.rpc.server import RPCServer
+
+    unsafe_srv = RPCServer(node, unsafe=True)
+    unsafe_srv.start()
+    url = unsafe_srv.address
+    try:
+        assert node.consensus.wait_for_height(2, timeout=60)
+        c_safe = HTTPClient(safe_url)
+        c = HTTPClient(url)
+
+        # gated on the safe server
+        with pytest.raises(Exception) as ei:
+            c_safe.call("unsafe_flush_mempool")
+        assert "unsafe" in str(ei.value)
+
+        # flush: park a tx in the mempool (consensus may race one
+        # commit, so assert emptiness only after the flush)
+        node.mempool.check_tx(b"zz=1")
+        assert c.call("unsafe_flush_mempool") == {}
+        assert c.call("num_unconfirmed_txs")["total"] == 0
+
+        # dial_seeds/dial_peers accept id@host:port lists; a dead
+        # target is fine — dialing is async and just fails later
+        r = c.call("dial_seeds",
+                   seeds=["ff" * 20 + "@127.0.0.1:1"])
+        assert "dialing" in r["log"]
+        r = c.call("dial_peers",
+                   peers=["ee" * 20 + "@127.0.0.1:1"],
+                   persistent=True)
+        assert "dialing" in r["log"]
+
+        # pprof-analog endpoints
+        with urllib.request.urlopen(url + "/debug/pprof/goroutine",
+                                    timeout=10) as resp:
+            stacks = resp.read().decode()
+        assert "thread" in stacks and "rpc-http" in stacks
+        with urllib.request.urlopen(
+                url + "/debug/pprof/profile?seconds=0.2",
+                timeout=10) as resp:
+            assert "function calls" in resp.read().decode()
+        # gated on the safe server (403)
+        try:
+            urllib.request.urlopen(safe_url + "/debug/pprof/goroutine",
+                                   timeout=10)
+            assert False, "expected 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+    finally:
+        unsafe_srv.stop()
+        node.stop()
