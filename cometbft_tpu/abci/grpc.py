@@ -20,6 +20,7 @@ modes (abci/types.py Application).
 from __future__ import annotations
 
 import json
+import threading
 from concurrent import futures
 from typing import Optional
 
@@ -44,13 +45,20 @@ class ABCIGRPCServer(BaseService):
     """abci/server/grpc_server.go: serve an Application over gRPC."""
 
     def __init__(self, app: abci.Application, host: str = "127.0.0.1",
-                 port: int = 0, max_workers: int = 8):
+                 port: int = 0, max_workers: int = 8,
+                 serialize_app: bool = True):
         super().__init__("ABCIGRPCServer")
         self.app = app
         self._host, self._port = host, port
         self._max_workers = max_workers
         self._server = None
         self.addr = (host, port)
+        # ABCI applications need not be concurrency-safe
+        # (abci/client/local_client.go's global-mutex model; the socket
+        # server holds the same lock). Requests still multiplex on the
+        # wire; a thread-safe app may pass serialize_app=False to let
+        # handler threads run it concurrently.
+        self._app_lock = threading.RLock() if serialize_app else None
 
     def _handler(self, method: str):
         app = self.app
@@ -60,19 +68,24 @@ class ABCIGRPCServer(BaseService):
 
             try:
                 doc = _dec(json.loads(request.decode()))
-                if method in _ARG_METHODS:
-                    fix = _ARG_METHODS[method][0]
-                    args = doc.get("a", [])
-                    if fix:
-                        args = fix(args)
-                    r = getattr(app, method)(*args)
-                else:
-                    req_cls, _ = _METHODS[method]
-                    if req_cls is None:
-                        r = getattr(app, method)()
+                import contextlib
+
+                guard = (self._app_lock if self._app_lock is not None
+                         else contextlib.nullcontext())
+                with guard:
+                    if method in _ARG_METHODS:
+                        fix = _ARG_METHODS[method][0]
+                        args = doc.get("a", [])
+                        if fix:
+                            args = fix(args)
+                        r = getattr(app, method)(*args)
                     else:
-                        r = getattr(app, method)(_rebuild(req_cls,
-                                                          doc["q"]))
+                        req_cls, _ = _METHODS[method]
+                        if req_cls is None:
+                            r = getattr(app, method)()
+                        else:
+                            r = getattr(app, method)(
+                                _rebuild(req_cls, doc["q"]))
                 return json.dumps(_enc(r)).encode()
             except Exception as e:  # noqa: BLE001 - app errors -> status
                 context.abort(grpc.StatusCode.INTERNAL,

@@ -24,13 +24,13 @@ Round-5 design (this file):
     4-level where-tree over in-VMEM int16 slices. (The round-4 design
     gathered entries with an MXU one-hot einsum OUTSIDE the kernel;
     its HBM traffic + transposes cost more than the curve math.)
-  * R is never decompressed. ZIP-215's cofactored equation
-    8([S]B) == 8R + 8([h]A) is checked as: exists T in E[8] with
-    W + T == decompress(R), W = [S]B + [h](-A) — eight torsion
-    candidates compared projectively against the R encoding, with the
-    sign bit resolved by ONE Montgomery-tree batched inversion in the
-    XLA epilogue. This deletes the per-lane ~250-squaring sqrt chain
-    AND the cofactor doublings AND the in-kernel canonical compares.
+  * the whole ZIP-215 check stays in ONE kernel: R decompression,
+    8W == identity with a single width-doubled canonical pass. (A
+    torsion-candidate variant that avoided decompressing R — compare
+    W + T over E[8] against the R encoding — was built, oracle-
+    validated and benchmarked this round; its XLA epilogue cost more
+    than the sqrt chain it removed, 18 vs 11.6 ms resident at 10k
+    sigs, so it was reverted. See git history.)
   * voting power rides in the table (valset data), so per-commit
     uploads carry only R/s/h/flags — 27 rows = 108 B/signature.
 
@@ -64,12 +64,17 @@ from cometbft_tpu.ops.field import F25519, NLIMBS
 from cometbft_tpu.ops.ed25519_pallas import (
     B_TILE,
     F,
+    _D_T,
     _D2_T,
     _M13,
+    _SQRT_M1_T,
+    decompress,
     pt_add,
+    pt_add_noT,
     pt_double,
     pt_double_p,
     pt_identity,
+    pt_neg,
 )
 from cometbft_tpu.ops.field_lf import const_col
 
@@ -95,10 +100,6 @@ V_H4 = 18       # 8 rows: nibble digits of h, digit d at row d%8
 V_FLAGS = 26    # rsign | precheck<<1 | counted<<2 | commit_id<<3
 V_KROWS = 27    # kernel block height (rows below are tally-side only)
 V_THRESH = 27   # flattened (n_commits, TALLY_LIMBS) thresholds
-
-# kernel output stanza per torsion candidate: ydiff @0, X @24, Z @48
-# (20-row fields in 24-row slots so every sublane store is 8-aligned)
-CAND_STRIDE = 72
 
 
 # --------------------------------------------------------------------------
@@ -330,6 +331,8 @@ def update_table(table: ValsetTable, changes,
     if not all(0 <= i < table.n_vals for i in idx_list):
         raise ValueError("change index beyond the table's padded size")
     pw_items = list((powers_by_idx or {}).items())
+    if not all(0 <= i < table.n_vals for i, _ in pw_items):
+        raise ValueError("power index beyond the table's padded size")
     # slots needing a write: key changes plus power-only changes that
     # don't coincide with a key change
     extra_pw = [i for i, _ in pw_items if i not in set(idx_list)]
@@ -486,60 +489,6 @@ def base60_dev():
 
 
 # --------------------------------------------------------------------------
-# torsion candidates (the R-decompression-free ZIP-215 check)
-# --------------------------------------------------------------------------
-#
-# ZIP-215 validity  8([S]B) == 8R + 8([h]A)  is equivalent to
-#   exists T in E[8]:  W + T == decompress(R),  W := [S]B + [h](-A)
-# (the cofactor multiplication IS the 8-torsion quotient). Comparing the
-# eight candidates' AFFINE coordinates against the R encoding removes
-# both the per-signature sqrt chain of R's decompression (~250
-# sequential squarings) and the 3 cofactor doublings + in-kernel
-# canonical compares of the round-4 design:
-#   * y-compare is projective: y(C) == y_R  <=>  Y_C - y_R * Z_C == 0;
-#   * the sign bit needs affine x for ONE selected candidate, via a
-#     cross-lane Montgomery tree inversion (3 muls/lane amortized) in
-#     the XLA epilogue — impossible inside the kernel, nearly free
-#     outside it.
-# Candidate-set facts (differentially validated vs the oracle,
-# tests/test_ed25519_cached.py): at most 2 candidates can share y_R;
-# exactly 2 means an {x, -x} pair, which satisfies any sign bit; 1 means
-# the sign bit must match parity(x) (or x == 0, the ZIP-215 "-0" rule).
-
-
-@functools.lru_cache(maxsize=1)
-def _torsion_niels():
-    """The 7 non-identity E[8] points as niels limb tuples
-    ((y-x), (y+x), 2dxy), for const_col materialization in-kernel."""
-    pt = None
-    y = 2
-    while pt is None:
-        y += 1
-        cand, _ = ref.pt_decompress(int.to_bytes(y, 32, "little"))
-        if cand is None:
-            continue
-        t = ref.pt_mul(ref.L, cand)
-        if ref.pt_equal(ref.pt_mul(4, t), ref.IDENT):
-            continue  # order < 8: need a generator
-        pt = t
-    out = []
-    cur = pt
-    for _ in range(7):
-        zi = pow(cur[2], ref.P - 2, ref.P)
-        x, yv = cur[0] * zi % ref.P, cur[1] * zi % ref.P
-        ym = (yv - x) % ref.P
-        yp = (yv + x) % ref.P
-        t2d = 2 * ref.D * x * yv % ref.P
-        out.append(tuple(
-            tuple(int(v) for v in F25519.from_int(c))
-            for c in (ym, yp, t2d)
-        ))
-        cur = ref.pt_add(cur, pt)
-    assert ref.pt_equal(cur, ref.IDENT), "E[8] generator has wrong order"
-    return tuple(out)
-
-
-# --------------------------------------------------------------------------
 # the kernel
 # --------------------------------------------------------------------------
 
@@ -584,9 +533,11 @@ def _sel16(ref, j: int, d_row):
     return vals[0]  # (64, b) int16
 
 
-def _kernel(packed_ref, base_ref, tab_ref, cand_ref, s8_ref, h4_ref):
+def _kernel(packed_ref, base_ref, tab_ref, valid_ref, s8_ref, h4_ref):
     b = B_TILE
+    d_col = const_col(_D_T, b)
     d2_col = const_col(_D2_T, b)
+    sqrt_m1_col = const_col(_SQRT_M1_T, b)
 
     pk = packed_ref[:, :]  # (V_KROWS, b)
     ry2 = pk[V_RY:V_RY + 10]
@@ -599,6 +550,11 @@ def _kernel(packed_ref, base_ref, tab_ref, cand_ref, s8_ref, h4_ref):
     h4_ref[:, :] = jnp.concatenate(
         [(h4p >> (4 * k)) & 15 for k in range(8)], axis=0
     )  # (64, b) nibble digits; nibble t at row t
+    flags = pk[V_FLAGS:V_FLAGS + 1]
+    rsign = flags & 1
+    pre = (flags >> 1) & 1
+
+    R, ok_r = decompress(ry, rsign, d_col, sqrt_m1_col)
 
     # h*(-A): Horner over 8 window positions, 8 in-kernel-gathered
     # entries each. Lane l of this tile is validator (i*128 + l) mod M,
@@ -637,31 +593,25 @@ def _kernel(packed_ref, base_ref, tab_ref, cand_ref, s8_ref, h4_ref):
 
     sB = jax.lax.fori_loop(0, 32, base_body, pt_identity(b))
 
-    W = pt_add(sB, acc, d2_col)
-
-    # torsion candidates C_i = W + T_i, T_i over E[8] (T_0 = identity).
-    # Emit, per candidate: ydiff = Y - y_R*Z (zero <=> y matches), X, Z
-    # — all raw (non-canonical) limbs; every compare, the sign-bit
-    # inversion and the validity boolean happen in the XLA epilogue
-    # (_verify_tally_cached) where cross-lane ops are cheap.
-    X, Y, Z, T = W
-    for i in range(8):
-        if i == 0:
-            Ci = (X, Y, Z)
-        else:
-            ym_t, yp_t, t2d_t = _torsion_niels()[i - 1]
-            ent = jnp.concatenate([
-                const_col(ym_t, b), const_col(yp_t, b),
-                const_col(t2d_t, b),
-            ], axis=0)
-            Ci = _madd_rows(W, ent, b)[:3]
-        # CAND_STRIDE slots keep every store 8-sublane aligned (20-row
-        # fields pad to 24; misaligned sublane stores cost relayouts)
-        cand_ref[pl.ds(i * CAND_STRIDE, NLIMBS), :] = F.sub(
-            Ci[1], F.mul(ry, Ci[2])
-        )
-        cand_ref[pl.ds(i * CAND_STRIDE + 24, NLIMBS), :] = Ci[0]
-        cand_ref[pl.ds(i * CAND_STRIDE + 48, NLIMBS), :] = Ci[2]
+    W = pt_add_noT(pt_add(sB, acc, d2_col), pt_neg(R), d2_col)
+    W8 = pt_double_p(pt_double_p(pt_double_p(W)))
+    # identity check X8==0 ∧ Y8==Z8 with ONE canonical pass: the two
+    # operands ride side-by-side on the lane axis, halving the
+    # sequential carry-ripple depth.
+    #
+    # (A torsion-candidate design — compare W+T over E[8] against the
+    # R encoding, no R decompression — was built, validated against
+    # the oracle, and benchmarked in round 5: its XLA epilogue's
+    # selects/canonicals/inversion cost MORE than the in-kernel sqrt
+    # chain it removed, 18 ms vs 11.6 ms resident at 10k sigs, so the
+    # decompress-R check stays. See git history for the variant.)
+    both = F.canonical(
+        jnp.concatenate([W8[0], F.sub(W8[1], W8[2])], axis=1)
+    )
+    z = jnp.all(both == 0, axis=0, keepdims=True)  # (1, 2b)
+    eq = z[:, :b] & z[:, b:]
+    valid = eq & ok_r & (pre != 0)
+    valid_ref[:, :] = valid.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("n_commits",))
@@ -692,46 +642,19 @@ def _verify_tally_cached(rows, tab, ok, power5, base, n_commits: int):
         (ENT_BLOCK, 128), lambda i: (i % mt, 0),
         memory_space=pltpu.VMEM,
     )
-    cand = pl.pallas_call(
+    out = pl.pallas_call(
         _kernel,
         interpret=(jax.default_backend() == "cpu"),
-        out_shape=jax.ShapeDtypeStruct((8 * CAND_STRIDE, B), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((1, B), jnp.int32),
         grid=grid,
         in_specs=[col(V_KROWS), full, tblock],
-        out_specs=col(8 * CAND_STRIDE),
+        out_specs=col(1),
         scratch_shapes=[
             pltpu.VMEM((32, B_TILE), jnp.int32),  # s byte digits
             pltpu.VMEM((64, B_TILE), jnp.int32),  # h nibble digits
         ],
     )(rows[:V_KROWS], base, tab)
-
-    # XLA epilogue: candidate compares + the sign bit. ONE wide
-    # canonical pass decides y-matches and x==0 for all 8 candidates
-    # (16B lanes side by side); the selected candidate's affine x comes
-    # from a Montgomery-tree batched inversion (~3 muls/lane) — the
-    # whole epilogue replaces the kernel's per-lane ~250-squaring R
-    # decompression of rounds 2-4.
-    cs = CAND_STRIDE
-    ydiffs = [cand[i * cs:i * cs + NLIMBS] for i in range(8)]
-    Xs = [cand[i * cs + 24:i * cs + 24 + NLIMBS] for i in range(8)]
-    Zs = [cand[i * cs + 48:i * cs + 48 + NLIMBS] for i in range(8)]
-    wide = F.canonical(jnp.concatenate(ydiffs + Xs, axis=1))
-    zflags = jnp.all(wide == 0, axis=0)  # (16B,)
-    ymatch = zflags[:8 * B].reshape(8, B)
-    xzero = zflags[8 * B:].reshape(8, B)
-    nmatch = ymatch.sum(axis=0)  # (B,) in {0, 1, 2}
-    msk = ymatch[:, None, :]
-    Xsel = sum(jnp.where(msk[i], Xs[i], 0) for i in range(8))
-    Zsel = sum(jnp.where(msk[i], Zs[i], 0) for i in range(8))
-    xzero_sel = jnp.any(ymatch & xzero, axis=0)  # (B,)
-    one_col = jnp.zeros((NLIMBS, B), jnp.int32).at[0].set(1)
-    Zsafe = jnp.where(nmatch[None, :] == 1, Zsel, one_col)
-    par = F.parity(F.mul(Xsel, F.batch_inv(Zsafe)))[0]  # (B,)
-    rsign = rows[V_FLAGS] & 1
-    pre = (rows[V_FLAGS] >> 1) & 1
-    sign_ok = xzero_sel | (par == rsign)
-    eq = (nmatch == 2) | ((nmatch == 1) & sign_ok)
-    valid = eq & (pre != 0) & jnp.take(ok, vidx, axis=0)
+    valid = (out[0] != 0) & jnp.take(ok, vidx, axis=0)
 
     # power comes from the valset table: row b is validator b mod M
     reps = -(-B // M)

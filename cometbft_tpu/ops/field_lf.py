@@ -255,52 +255,3 @@ class FieldLF:
     def parity(self, x):
         """(NLIMBS, B) -> (1, B) int32 LSB of the canonical value."""
         return self.canonical(x)[0:1] & 1
-
-
-    # -- inversion ------------------------------------------------------------
-
-    def inv(self, x):
-        """x^(p-2) for p = 2^255-19 (ref10 invert ladder); inv(0) = 0."""
-        z2 = self.square(x)
-        z8 = self.pow2k(z2, 2)
-        z9 = self.mul(x, z8)
-        z11 = self.mul(z2, z9)
-        z22 = self.square(z11)
-        z_5_0 = self.mul(z9, z22)
-        z_10_0 = self.mul(self.pow2k(z_5_0, 5), z_5_0)
-        z_20_0 = self.mul(self.pow2k(z_10_0, 10), z_10_0)
-        z_40_0 = self.mul(self.pow2k(z_20_0, 20), z_20_0)
-        z_50_0 = self.mul(self.pow2k(z_40_0, 10), z_10_0)
-        z_100_0 = self.mul(self.pow2k(z_50_0, 50), z_50_0)
-        z_200_0 = self.mul(self.pow2k(z_100_0, 100), z_100_0)
-        z_250_0 = self.mul(self.pow2k(z_200_0, 50), z_50_0)
-        return self.mul(self.pow2k(z_250_0, 5), z11)
-
-    def batch_inv(self, x):
-        """Montgomery-tree batched inversion along the LANE axis: one
-        scalar Fermat chain for the tree root + ~3 full-width muls,
-        instead of a 254-squaring chain per lane.
-
-        x (NLIMBS, B); zero elements MUST be masked out by the caller
-        first (a single zero poisons every product in its subtree —
-        replace them with 1 and zero the result).
-        """
-        b = x.shape[1]
-        width = 1
-        while width < b:
-            width *= 2
-        if width != b:
-            one = const_col((1,) + (0,) * (NLIMBS - 1), width - b)
-            x = jnp.concatenate([x, one], axis=1)
-        levels = []
-        cur = x
-        while cur.shape[1] > 1:
-            levels.append(cur)
-            cur = self.mul(cur[:, 0::2], cur[:, 1::2])
-        inv = self.inv(cur)  # (NLIMBS, 1)
-        for lvl in reversed(levels):
-            left = self.mul(inv, lvl[:, 1::2])   # inv of even slots
-            right = self.mul(inv, lvl[:, 0::2])  # inv of odd slots
-            w = lvl.shape[1]
-            inv = jnp.stack([left, right], axis=2).reshape(NLIMBS, w)
-        return inv[:, :b]

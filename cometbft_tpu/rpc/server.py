@@ -512,7 +512,10 @@ class Routes:
 
     def dial_peers(self, peers=None, persistent=False,
                    unconditional=False, private=False):
-        """rpc/core/net.go UnsafeDialPeers."""
+        """rpc/core/net.go UnsafeDialPeers. This switch has no
+        unconditional/private peer classes (no peer-count eviction,
+        and PEX gossips only book entries, not live peers), so those
+        flags are accepted for API parity and reported as no-ops."""
         if self.node.switch is None:
             raise RPCError(-32603, "p2p is disabled")
         if isinstance(peers, str):
@@ -521,7 +524,10 @@ class Routes:
             persistent = persistent.lower() == "true"
         for a in self._addrs_arg(peers or []):
             self.node.switch.dial_peer(a, persistent=bool(persistent))
-        return {"log": f"dialing peers in progress: {peers}"}
+        log = f"dialing peers in progress: {peers}"
+        if unconditional or private:
+            log += " (unconditional/private are no-ops here)"
+        return {"log": log}
 
     def unsafe_flush_mempool(self):
         """rpc/core/mempool.go UnsafeFlushMempool."""
@@ -681,17 +687,34 @@ class _Handler(BaseHTTPRequestHandler):
                 buf.write("\n")
             body = buf.getvalue().encode()
         elif kind == "profile":
-            import cProfile
-            import pstats
-
             seconds = min(float(q.get("seconds", 2)), 30.0)
-            pr = cProfile.Profile()
-            pr.enable()
-            time.sleep(seconds)  # samples THIS thread + enabled scope
-            pr.disable()
+            # statistical whole-process sampler: walk every thread's
+            # stack via sys._current_frames at ~100 Hz for the window
+            # (cProfile can only instrument frames its own thread
+            # enters — useless here; Go's pprof is signal-based for
+            # the same reason)
+            import sys as _sys
+            from collections import Counter
+
+            samples: Counter = Counter()
+            deadline = time.monotonic() + seconds
+            nsamp = 0
+            me = threading.get_ident()
+            while time.monotonic() < deadline:
+                for tid, fr in _sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    co = fr.f_code
+                    samples[f"{co.co_qualname} "
+                            f"({co.co_filename.rsplit('/', 1)[-1]}:"
+                            f"{fr.f_lineno})"] += 1
+                nsamp += 1
+                time.sleep(0.01)
             buf = io.StringIO()
-            pstats.Stats(pr, stream=buf).sort_stats("cumulative") \
-                .print_stats(60)
+            buf.write(f"statistical profile: {nsamp} samples over "
+                      f"{seconds}s, all threads, innermost frame\n")
+            for loc, cnt in samples.most_common(60):
+                buf.write(f"{cnt / max(nsamp, 1) * 100:6.1f}%  {loc}\n")
             body = buf.getvalue().encode()
         elif kind == "heap":
             import tracemalloc

@@ -59,17 +59,19 @@ CREATE TABLE IF NOT EXISTS attributes (
 );
 """
 
+# "IF NOT EXISTS" is sqlite view syntax; postgres wants OR REPLACE
+# (the dialect rewrite below swaps it)
 VIEWS = """
-CREATE VIEW IF NOT EXISTS event_attributes AS
+CREATE OR REPLACE VIEW event_attributes AS
   SELECT block_id, tx_id, type, key, composite_key, value
   FROM events LEFT JOIN attributes ON (events.rowid = attributes.event_id);
-CREATE VIEW IF NOT EXISTS block_events AS
+CREATE OR REPLACE VIEW block_events AS
   SELECT blocks.rowid as block_id, height, chain_id, type, key,
          composite_key, value
   FROM blocks JOIN event_attributes
     ON (blocks.rowid = event_attributes.block_id)
   WHERE event_attributes.tx_id IS NULL;
-CREATE VIEW IF NOT EXISTS tx_events AS
+CREATE OR REPLACE VIEW tx_events AS
   SELECT height, "index", chain_id, type, key, composite_key, value,
          tx_results.created_at
   FROM blocks JOIN tx_results ON (blocks.rowid = tx_results.block_id)
@@ -101,6 +103,8 @@ class PsqlEventSink:
                          ("VARCHAR", "TEXT")):
                 schema = schema.replace(a, b)
                 views = views.replace(a, b)
+            views = views.replace("CREATE OR REPLACE VIEW",
+                                  "CREATE VIEW IF NOT EXISTS")
         cur = self.conn.cursor()
         for stmt in (schema + views).split(";"):
             if stmt.strip():
@@ -188,12 +192,28 @@ class PsqlEventSink:
                 (height, self.chain_id),
             )
             row = cur.fetchone()
-            block_id = row[0] if row else self._insert_returning(
-                cur,
-                "INSERT INTO blocks (height, chain_id, created_at) "
-                "VALUES (%s, %s, %s)",
-                (height, self.chain_id, self._now()),
-            )
+            if row:
+                block_id = row[0]
+                # re-delivery (restart replay) REPLACES the height's
+                # block-level events instead of duplicating them
+                cur.execute(
+                    self._q("DELETE FROM attributes WHERE event_id IN "
+                            "(SELECT rowid FROM events WHERE "
+                            "block_id = %s AND tx_id IS NULL)"),
+                    (block_id,),
+                )
+                cur.execute(
+                    self._q("DELETE FROM events WHERE block_id = %s "
+                            "AND tx_id IS NULL"),
+                    (block_id,),
+                )
+            else:
+                block_id = self._insert_returning(
+                    cur,
+                    "INSERT INTO blocks (height, chain_id, created_at) "
+                    "VALUES (%s, %s, %s)",
+                    (height, self.chain_id, self._now()),
+                )
             base = {"block.height": [str(height)]}
             self._insert_events(cur, block_id, None,
                                 {**base, **(events or {})})

