@@ -21,6 +21,7 @@ from typing import Callable, List, Optional
 
 from cometbft_tpu.blocksync.pipeline import CommitJob, StreamVerifier
 from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.state.state import State
@@ -28,6 +29,11 @@ from cometbft_tpu.store.blockstore import BlockStore
 from cometbft_tpu.types.block import Block
 
 MAX_RUN = 64  # blocks fused per device pass (64 x 1k sigs fills a bucket)
+
+fp.register("blocksync.process",
+            "a run of verified-ready blocks about to be processed "
+            "(raise = transient local verify/apply fault; the loop "
+            "retries without banning the serving peers)")
 
 
 class BlocksyncReactor(BaseService):
@@ -82,10 +88,12 @@ class BlocksyncReactor(BaseService):
     def _pool_routine(self) -> None:
         """poolRoutine (reactor.go:286)."""
         started = time.time()
+        peerless_since = started
         while self.is_running():
             self.pool.make_requests()
             elapsed = time.time() - started
             if self.pool.num_peers() > 0:
+                peerless_since = time.time()
                 # peers known: caught up when nobody is ahead (after a
                 # short grace so statuses can land)
                 done = self.pool.is_caught_up() or (
@@ -97,8 +105,17 @@ class BlocksyncReactor(BaseService):
                 # zero peers: wait longer before giving up — declaring
                 # caught-up on an empty pool mid-handshake would strand
                 # a lagging node in consensus (the lonely-node arm keeps
-                # single-validator operation bootable)
-                done = elapsed > max(self.grace, 10.0)
+                # single-validator operation bootable). The clock runs
+                # from when peers VANISHED, not reactor start (timeout
+                # eviction can empty a mid-sync pool), and a node that
+                # ever saw a higher advertised tip must not declare
+                # done below it — wait for peers to re-register via
+                # their next status instead.
+                done = (
+                    time.time() - peerless_since > max(self.grace, 10.0)
+                    and self.state.last_block_height
+                    >= self.pool.max_seen_height() - 1
+                )
             if done:
                 if self.on_caught_up:
                     self.on_caught_up(self.state)
@@ -119,6 +136,7 @@ class BlocksyncReactor(BaseService):
     def _process_run(self, run: List[Block]) -> None:
         """Verify blocks run[0..n-2] using each successor's LastCommit in
         one fused pass, then apply them in order."""
+        fp.fail_point("blocksync.process")
         n = len(run) - 1
         jobs = []
         for i in range(n):

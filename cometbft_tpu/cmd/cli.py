@@ -75,6 +75,9 @@ def build_node(home: str, cfg=None):
     from cometbft_tpu.types.genesis import GenesisDoc
 
     cfg = cfg or load_config(_config_path(home))
+    # arm configured failpoints before any instrumented module runs a
+    # seam (CBT_FAILPOINTS env arming happens lazily regardless)
+    cfg.failpoints.apply()
     cfgdir = os.path.join(home, "config")
     doc = GenesisDoc.from_file(os.path.join(cfgdir, "genesis.json"))
     pa = cfg.base.proxy_app
@@ -488,6 +491,9 @@ def cmd_light(args) -> int:
         host=host, port=port,
         db_path=(os.path.join(args.home, "light.db")
                  if args.home else None),
+        # --insecure-trust also covers mid-run expiry of a persisted
+        # root; without it the proxy errors instead of re-rooting TOFU
+        insecure_allow_reroot=bool(args.insecure_trust),
     )
     proxy.start()
     print(f"light proxy listening on {proxy.address} "

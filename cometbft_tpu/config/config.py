@@ -10,7 +10,11 @@ section selecting the signature-verification backend — `verifier =
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the vendored tomli is identical
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 
@@ -80,13 +84,38 @@ class CryptoConfig:
 
     verifier: str = "tpu"   # "tpu" | "cpu"
     device: str = ""        # informational (e.g. "v5e-1")
+    # device circuit breaker (crypto/batch.py): consecutive kernel
+    # faults before batches fall back to the host verify path, and how
+    # often an open breaker re-probes the device
+    breaker_failure_threshold: int = 2
+    breaker_cooldown: float = 30.0
 
     def batch_fn(self):
+        from cometbft_tpu.crypto import batch as cbatch
+
+        cbatch.configure_breaker(self.breaker_failure_threshold,
+                                 self.breaker_cooldown)
         if self.verifier == "cpu":
             return None
         from cometbft_tpu.types import validation
 
         return validation.device_batch_fn()
+
+
+@dataclass
+class FailpointsConfig:
+    """Deterministic fault injection (libs/failpoints.py). `spec` uses
+    the same syntax as the CBT_FAILPOINTS env var:
+    ``name=action[:arg][*count][;...]`` with actions
+    crash|raise|delay|flake. Empty = nothing armed."""
+
+    spec: str = ""
+
+    def apply(self) -> None:
+        if self.spec:
+            from cometbft_tpu.libs import failpoints
+
+            failpoints.arm_from_spec(self.spec)
 
 
 @dataclass
@@ -97,6 +126,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
 
     def validate_basic(self) -> None:
         if not self.base.chain_id:
@@ -106,6 +136,21 @@ class Config:
                 f"[crypto] verifier must be tpu|cpu, "
                 f"got {self.crypto.verifier!r}"
             )
+        if self.crypto.breaker_failure_threshold < 1:
+            raise ConfigError(
+                "[crypto] breaker_failure_threshold must be >= 1"
+            )
+        if self.crypto.breaker_cooldown < 0:
+            raise ConfigError("[crypto] breaker_cooldown must be >= 0")
+        if self.failpoints.spec:
+            # parse-validate without arming: a typo'd spec must fail at
+            # config load, not silently never fire
+            from cometbft_tpu.libs.failpoints import parse_spec
+
+            try:
+                parse_spec(self.failpoints.spec)
+            except ValueError as e:
+                raise ConfigError(f"[failpoints] bad spec: {e}")
         for name in ("timeout_propose", "timeout_prevote",
                      "timeout_precommit", "timeout_commit"):
             if getattr(self.consensus, name) < 0:
@@ -126,7 +171,7 @@ def _render(cfg: Config) -> str:
     for section, obj in [
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
-        ("crypto", cfg.crypto),
+        ("crypto", cfg.crypto), ("failpoints", cfg.failpoints),
     ]:
         out.append(f"[{section}]")
         for k, val in vars(obj).items():
@@ -147,7 +192,7 @@ def load_config(path: str) -> Config:
     for section, obj in [
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
-        ("crypto", cfg.crypto),
+        ("crypto", cfg.crypto), ("failpoints", cfg.failpoints),
     ]:
         for k, val in doc.get(section, {}).items():
             if not hasattr(obj, k):

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 
 from cometbft_tpu.consensus import wal as walmod
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
+from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.consensus.ticker import (
     ManualTicker,
     TimeoutInfo,
@@ -49,6 +50,21 @@ from cometbft_tpu.types.vote_set import (
 )
 
 _log = logging.getLogger(__name__)
+
+# The WAL-write-before-process discipline (state.go:820) is exactly
+# what crash recovery relies on — these points let the recovery matrix
+# kill the node on either side of each durable write (libs/fail's
+# call sites in the reference consensus state).
+fp.register("consensus.wal.pre_vote", "before a vote is WAL-synced")
+fp.register("consensus.wal.post_vote", "after a vote is WAL-synced")
+fp.register("consensus.wal.pre_proposal",
+            "before a proposal is WAL-synced")
+fp.register("consensus.wal.post_proposal",
+            "after a proposal is WAL-synced")
+fp.register("consensus.pre_finalize",
+            "decided block about to be persisted + applied")
+fp.register("consensus.post_block_save",
+            "block persisted, ENDHEIGHT not yet written")
 
 # RoundStep* (consensus/types/round_state.go:12-24)
 STEP_NEW_HEIGHT = 1
@@ -133,6 +149,8 @@ class ConsensusState(BaseService):
         # observability (consensus/metrics.go:24-91 analog); set by Node
         self.metrics = None
         self._last_commit_walltime = 0.0
+        # set when a SimulatedCrash failpoint killed the machine
+        self.crashed = False
 
     # ---------------------------------------------------------------------
     # service lifecycle
@@ -221,10 +239,34 @@ class ConsensusState(BaseService):
                 continue
             try:
                 self._handle(item, write_wal=True)
+            except fp.SimulatedCrash as e:
+                # the in-process stand-in for a process kill: halt the
+                # machine dead (no graceful teardown) so the crash-
+                # recovery tests can restart over the same home dir
+                self._halt(str(e))
+                return
             except Exception:  # noqa: BLE001 - engine must not die silently
                 import traceback
 
                 traceback.print_exc()
+
+    def _halt(self, reason: str) -> None:
+        """Kill the machine in place (crash simulation landing): marks
+        the service stopped without the graceful on_stop path — the
+        receive routine IS the current thread, so on_stop's join would
+        deadlock. The WAL close is best-effort; a real crash would not
+        even get that."""
+        _log.error("consensus HALTED (simulated crash): %s", reason)
+        self.crashed = True
+        with self._lock:
+            self._stopped = True
+        self._quit.set()
+        self.ticker.stop()
+        if self.wal:
+            try:
+                self.wal.close()
+            except Exception:  # noqa: BLE001 - crash path, best-effort
+                pass
 
     def _next_msg(self, timeout: float = 0.1):
         try:
@@ -275,10 +317,13 @@ class ConsensusState(BaseService):
     def _wal_write(self, item) -> None:
         kind = item[0]
         if kind == "vote":
+            fp.fail_point("consensus.wal.pre_vote")
             self.wal.write_sync(walmod.MSG_INFO, json.dumps(
                 {"t": "vote", "v": serde.vote_to_j(item[1].vote)}
             ).encode())
+            fp.fail_point("consensus.wal.post_vote")
         elif kind == "proposal":
+            fp.fail_point("consensus.wal.pre_proposal")
             msg: ProposalMsg = item[1]
             self.wal.write_sync(walmod.MSG_INFO, json.dumps({
                 "t": "proposal",
@@ -292,6 +337,7 @@ class ConsensusState(BaseService):
                 },
                 "b": json.loads(serde.block_to_json(msg.block)),
             }).encode())
+            fp.fail_point("consensus.wal.post_proposal")
         elif kind == "timeout":
             ti: TimeoutInfo = item[1]
             self.wal.write(walmod.TIMEOUT_INFO, struct.pack(
@@ -804,6 +850,7 @@ class ConsensusState(BaseService):
     def _finalize_commit(self, height: int, block_id: BlockID,
                          block: Block) -> None:
         """state.go:1739: persist, apply through ABCI, move to next height."""
+        fp.fail_point("consensus.pre_finalize")
         precommits = self.votes.precommits(self.commit_round)
         ext_commit = None
         if self.state.consensus_params.extensions_enabled(height):
@@ -813,6 +860,7 @@ class ConsensusState(BaseService):
             seen_commit = precommits.make_commit()
         self.block_store.save_block(block, seen_commit,
                                     extended_commit=ext_commit)
+        fp.fail_point("consensus.post_block_save")
         if self.wal:
             self.wal.write_end_height(height)
         new_state = self.block_exec.apply_block(

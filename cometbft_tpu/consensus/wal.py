@@ -11,13 +11,26 @@ implementation wire format).
 """
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import zlib
 from dataclasses import dataclass
 from typing import Iterator, Optional, Tuple
 
+from cometbft_tpu.libs import failpoints as fp
+
+_log = logging.getLogger(__name__)
+
 MAX_MSG_SIZE = 1 << 20  # 1MB, wal.go:28
+
+# crash-prone seams of the WAL itself (libs/fail call sites of the
+# reference live one layer up in consensus; these cover the file ops)
+fp.register("wal.pre_write", "before a record is buffered")
+fp.register("wal.post_write", "after a record is buffered, pre-fsync")
+fp.register("wal.pre_fsync", "after flush, before fsync reaches disk")
+fp.register("wal.mid_rotate",
+            "head renamed to segment, new head not yet open")
 
 # record kinds
 END_HEIGHT = 0
@@ -58,15 +71,66 @@ class WAL:
         self.head_size_limit = head_size_limit
         self.max_segments = max_segments
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        dropped = self.repair_tail(path)
+        if dropped:
+            _log.warning(
+                "wal: repaired corrupt tail of %s (%d bytes dropped)",
+                path, dropped,
+            )
         self._f = open(path, "ab")
+
+    @staticmethod
+    def repair_tail(path: str) -> int:
+        """Truncate a torn/corrupt tail off the HEAD file so appends
+        land after the last valid record. Returns bytes dropped.
+
+        A crash mid-write leaves a torn frame (or fsync'd garbage) at
+        the end of the head; the replay decoder stops there, but a
+        node that keeps APPENDING after it would write records the
+        decoder can never reach — every post-restart record would be
+        silently invisible to the next replay. The reference repairs
+        this in autofile/group + wal.go's corrupted-WAL handling; here
+        the repair runs on open, before the append handle is created.
+        """
+        if not os.path.exists(path):
+            return 0
+        size = os.path.getsize(path)
+        good_end = WAL._scan_valid_prefix(path)
+        if good_end >= size:
+            return 0
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+        return size - good_end
+
+    @staticmethod
+    def _scan_valid_prefix(path: str) -> int:
+        """Byte offset just past the last valid record frame."""
+        good = 0
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    break
+                crc, length = struct.unpack(">II", head)
+                if length == 0 or length > MAX_MSG_SIZE:
+                    break
+                payload = f.read(length)
+                if len(payload) < length:
+                    break
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    break
+                good += 8 + length
+        return good
 
     def write(self, kind: int, data: bytes) -> None:
         """Buffered write (wal.go:185 Write)."""
+        fp.fail_point("wal.pre_write")
         payload = bytes([kind]) + data
         if len(payload) > MAX_MSG_SIZE:
             raise WALError(f"msg is too big: {len(payload)}")
         crc = zlib.crc32(payload) & 0xFFFFFFFF
         self._f.write(struct.pack(">II", crc, len(payload)) + payload)
+        fp.fail_point("wal.post_write")
 
     def write_sync(self, kind: int, data: bytes) -> None:
         """Write + flush + fsync (wal.go:202 WriteSync) — used for every
@@ -87,6 +151,7 @@ class WAL:
         seqs = self._segments()
         nxt = (seqs[-1] + 1) if seqs else 0
         os.replace(self.path, f"{self.path}.{nxt:03d}")
+        fp.fail_point("wal.mid_rotate")
         self._f = open(self.path, "ab")
         seqs.append(nxt)
         for old in seqs[: max(0, len(seqs) - self.max_segments)]:
@@ -106,11 +171,14 @@ class WAL:
 
     def flush_and_sync(self) -> None:
         self._f.flush()
+        fp.fail_point("wal.pre_fsync")
         os.fsync(self._f.fileno())
 
     def close(self) -> None:
         try:
             self.flush_and_sync()
+        except (ValueError, OSError):
+            pass  # handle already closed (e.g. a crash mid-rotation)
         finally:
             self._f.close()
 

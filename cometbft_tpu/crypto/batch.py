@@ -12,9 +12,23 @@ further than the reference in two ways:
 The batch_fn signature used across validation.py: fn(pubs, msgs, sigs)
 with pubs a sequence of crypto.keys.PubKey; returns (n,) bool validity —
 the per-signature slice the blame path needs (types/validation.go:243).
+
+Degraded mode: every kernel dispatch runs under a circuit breaker. A
+device fault (XLA error, tunnel loss, injected `crypto.device_dispatch`
+failpoint) is caught, logged, and the batch re-verified on the host
+single-signature path — a sick TPU costs throughput, never consensus
+liveness. After `failure_threshold` consecutive faults the breaker
+OPENS and batches go straight to the host path; every `cooldown`
+seconds one batch probes the device again (half-open), and a success
+closes the breaker. Measurements on committee-based consensus (arXiv:
+2302.00418) put verification squarely on the liveness-critical path,
+which is why the fallback is tested, not assumed.
 """
 from __future__ import annotations
 
+import logging
+import threading
+import time
 from collections import defaultdict
 from typing import Callable, List, Sequence
 
@@ -26,13 +40,109 @@ from cometbft_tpu.crypto.keys import (
     SR25519_KEY_TYPE,
     PubKey,
 )
+from cometbft_tpu.libs import failpoints as fp
+
+_log = logging.getLogger(__name__)
 
 _BATCHABLE = {ED25519_KEY_TYPE, SECP256K1_KEY_TYPE, SR25519_KEY_TYPE}
+
+fp.register("crypto.device_dispatch",
+            "device kernel about to run (raise = device fault; the "
+            "breaker + host fallback must keep verdicts correct)")
 
 
 def supports_batch_verifier(key_type: str) -> bool:
     """crypto/batch/batch.go:24-32 analog (plus secp256k1)."""
     return key_type in _BATCHABLE
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with timed half-open probes.
+
+    closed  — device healthy, every batch dispatches to it.
+    open    — device sick: batches take the host path; once per
+              `cooldown` seconds a single batch is let through as a
+              probe (half-open). Probe success -> closed; probe
+              failure -> stay open, restart the cooldown clock.
+    """
+
+    def __init__(self, failure_threshold: int = 2,
+                 cooldown: float = 30.0, name: str = "device"):
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown = cooldown
+        self.name = name
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._open_until = 0.0
+        self._is_open = False
+        self.trips = 0        # times the breaker opened (ops counter)
+        self.probes = 0       # half-open probes attempted
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return "open" if self._is_open else "closed"
+
+    def allow(self) -> bool:
+        """True -> caller may try the device (normal or probe)."""
+        with self._lock:
+            if not self._is_open:
+                return True
+            now = time.monotonic()
+            if now >= self._open_until:
+                # claim the probe slot; concurrent callers keep falling
+                # back until this probe resolves or the clock lapses
+                self._open_until = now + self.cooldown
+                self.probes += 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            was_open = self._is_open
+            self._failures = 0
+            self._is_open = False
+        if was_open:
+            _log.warning("circuit breaker %s: device recovered, "
+                         "breaker CLOSED", self.name)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            now_tripping = (not self._is_open
+                            and self._failures >= self.failure_threshold)
+            if now_tripping:
+                self._is_open = True
+                self.trips += 1
+            if self._is_open:
+                self._open_until = time.monotonic() + self.cooldown
+        if now_tripping:
+            _log.error(
+                "circuit breaker %s: OPEN after %d consecutive device "
+                "faults; verifying on the host path, re-probing every "
+                "%.1fs", self.name, self._failures, self.cooldown,
+            )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._is_open = False
+            self._open_until = 0.0
+
+
+# One breaker for THE device: all kernels share the accelerator, so one
+# sick tunnel should move every key type to the host path at once.
+_DEVICE_BREAKER = CircuitBreaker(name="verify-device")
+
+
+def device_breaker() -> CircuitBreaker:
+    return _DEVICE_BREAKER
+
+
+def configure_breaker(failure_threshold: int, cooldown: float) -> None:
+    """Apply [crypto] breaker knobs (config.py) to the global breaker."""
+    _DEVICE_BREAKER.failure_threshold = max(1, failure_threshold)
+    _DEVICE_BREAKER.cooldown = cooldown
 
 
 def _accel_backend() -> bool:
@@ -68,17 +178,31 @@ def _kernel_for(key_type: str) -> Callable:
     raise ValueError(f"no batch verifier for key type {key_type!r}")
 
 
+def _host_verify_rows(pubs, msgs, sigs, idxs, valid) -> None:
+    """Host fallback: per-row single verify via the reference-path
+    PubKey.verify_signature (ed25519_ref and friends). Fills `valid`
+    in place for the given indices."""
+    for i in idxs:
+        try:
+            valid[i] = pubs[i].verify_signature(msgs[i], sigs[i])
+        except ValueError:
+            valid[i] = False
+
+
 def verify_batch(
     pubs: Sequence[PubKey],
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     kernels: dict = None,
+    breaker: CircuitBreaker = None,
 ) -> np.ndarray:
     """Verify a (possibly mixed-key-type) batch; (n,) bool validity.
 
-    kernels overrides the per-type kernel (e.g. the Pallas ed25519 path)."""
+    kernels overrides the per-type kernel (e.g. the Pallas ed25519 path).
+    breaker overrides the global device circuit breaker (tests)."""
     n = len(pubs)
     valid = np.zeros((n,), np.bool_)
+    brk = breaker if breaker is not None else _DEVICE_BREAKER
     groups: dict = defaultdict(list)
     for i, p in enumerate(pubs):
         groups[p.key_type].append(i)
@@ -86,19 +210,30 @@ def verify_batch(
         if kt not in _BATCHABLE:
             # unknown type: per-row single verify; a type with no verifier
             # at all marks the row invalid instead of raising mid-batch
-            for i in idxs:
-                try:
-                    valid[i] = pubs[i].verify_signature(msgs[i], sigs[i])
-                except ValueError:
-                    valid[i] = False
+            _host_verify_rows(pubs, msgs, sigs, idxs, valid)
             continue
-        kernel = (kernels or {}).get(kt) or _kernel_for(kt)
-        sub = kernel(
-            [pubs[i].data for i in idxs],
-            [msgs[i] for i in idxs],
-            [sigs[i] for i in idxs],
-        )
-        valid[np.asarray(idxs)] = np.asarray(sub)
+        sub = None
+        if brk.allow():
+            kernel = (kernels or {}).get(kt) or _kernel_for(kt)
+            try:
+                fp.fail_point("crypto.device_dispatch")
+                sub = kernel(
+                    [pubs[i].data for i in idxs],
+                    [msgs[i] for i in idxs],
+                    [sigs[i] for i in idxs],
+                )
+                brk.record_success()
+            except Exception:  # noqa: BLE001 - device fault, not verdict
+                brk.record_failure()
+                _log.exception(
+                    "device batch verify failed for %s (%d sigs); "
+                    "falling back to the host path", kt, len(idxs),
+                )
+                sub = None
+        if sub is None:
+            _host_verify_rows(pubs, msgs, sigs, idxs, valid)
+        else:
+            valid[np.asarray(idxs)] = np.asarray(sub)
     return valid
 
 

@@ -5,8 +5,11 @@ Reference: crypto/crypto.go:22-42 (interfaces, Address = SumTruncated),
 crypto/ed25519/ed25519.go:109 (Sign), :156 (GenPrivKey), :181
 (VerifySignature).
 
-Signing uses OpenSSL (`cryptography` package) — constant-time, C speed.
-Single verification uses the pure-Python ZIP-215 oracle
+Signing uses OpenSSL (`cryptography` package) when available —
+constant-time, C speed — and degrades to the pure-Python RFC 8032 path
+(ed25519_ref.sign) when the package is missing: key handling must not
+take the node down with it (same gate-don't-require rule as the device
+backends). Single verification uses the pure-Python ZIP-215 oracle
 (crypto/ed25519_ref.py), NOT OpenSSL: OpenSSL's Ed25519 verify is
 cofactorless and rejects some encodings ZIP-215 accepts, and the
 reference pins ZIP-215 semantics for consensus compatibility
@@ -18,15 +21,21 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pure-Python fallback below
+    Ed25519PrivateKey = None
+    _HAVE_OPENSSL = False
 
 from cometbft_tpu.crypto import ed25519_ref
 from cometbft_tpu.crypto import tmhash
@@ -83,16 +92,18 @@ class PrivKey:
     @staticmethod
     def generate(seed: Optional[bytes] = None) -> "PrivKey":
         if seed is None:
-            sk = Ed25519PrivateKey.generate()
-            seed = sk.private_bytes(
-                Encoding.Raw, PrivateFormat.Raw, NoEncryption()
-            )
+            import os as _os
+
+            seed = _os.urandom(32)
         assert len(seed) == 32
-        pub = (
-            Ed25519PrivateKey.from_private_bytes(seed)
-            .public_key()
-            .public_bytes(Encoding.Raw, PublicFormat.Raw)
-        )
+        if _HAVE_OPENSSL:
+            pub = (
+                Ed25519PrivateKey.from_private_bytes(seed)
+                .public_key()
+                .public_bytes(Encoding.Raw, PublicFormat.Raw)
+            )
+        else:
+            pub = ed25519_ref.pubkey_from_seed(seed)
         return PrivKey(seed + pub)
 
     @property
@@ -103,8 +114,11 @@ class PrivKey:
         return PubKey(self.data[32:])
 
     def sign(self, msg: bytes) -> bytes:
-        """RFC 8032 deterministic signature via OpenSSL."""
-        return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
+        """RFC 8032 deterministic signature (OpenSSL when present, the
+        pure-Python reference path otherwise — identical output)."""
+        if _HAVE_OPENSSL:
+            return Ed25519PrivateKey.from_private_bytes(self.seed).sign(msg)
+        return ed25519_ref.sign(self.seed, msg)
 
 
 @dataclass(frozen=True)

@@ -10,12 +10,22 @@ Semantics mirror the reference's secp256k1 component
 
 This module is a test oracle and host-side signer; bulk verification
 routes to the batched device kernel (ops/secp256k1.py). Signing uses
-OpenSSL (`cryptography`) with the signature normalized to low-S.
+OpenSSL (`cryptography`) with the signature normalized to low-S when
+the package is present, and a pure-Python RFC 6979 deterministic-k
+path otherwise (missing optional deps must degrade, not crash).
 """
 from __future__ import annotations
 
 import hashlib
+import hmac as _hmac
 from typing import Optional, Tuple
+
+try:
+    import cryptography  # noqa: F401
+
+    _HAVE_OPENSSL = True
+except ImportError:
+    _HAVE_OPENSSL = False
 
 # Curve parameters: y^2 = x^3 + 7 over F_p, group order N.
 P = 2**256 - 2**32 - 977
@@ -93,8 +103,11 @@ def _derived_key(d: int):
 
 
 def pubkey_from_secret(d: int) -> bytes:
-    """Compressed pubkey via OpenSSL (the pure-Python pt_mul took ~20 ms
-    per key — 10k-validator fixtures need C-speed derivation)."""
+    """Compressed pubkey via OpenSSL (the pure-Python pt_mul takes ~20 ms
+    per key — 10k-validator fixtures want C-speed derivation), falling
+    back to pt_mul when the bindings are absent."""
+    if not _HAVE_OPENSSL:
+        return compress(*pt_mul(d, (GX, GY)))
     from cryptography.hazmat.primitives.serialization import (
         Encoding,
         PublicFormat,
@@ -113,8 +126,44 @@ def address(pub: bytes) -> bytes:
 # -- sign / verify ---------------------------------------------------------
 
 
+def _rfc6979_k(d: int, z: int) -> int:
+    """RFC 6979 deterministic nonce (SHA-256) — the no-OpenSSL signing
+    path must never depend on the quality of os.urandom for k."""
+    x = d.to_bytes(32, "big")
+    h1 = (z % N).to_bytes(32, "big")
+    V = b"\x01" * 32
+    K = b"\x00" * 32
+    K = _hmac.new(K, V + b"\x00" + x + h1, hashlib.sha256).digest()
+    V = _hmac.new(K, V, hashlib.sha256).digest()
+    K = _hmac.new(K, V + b"\x01" + x + h1, hashlib.sha256).digest()
+    V = _hmac.new(K, V, hashlib.sha256).digest()
+    while True:
+        V = _hmac.new(K, V, hashlib.sha256).digest()
+        k = int.from_bytes(V, "big")
+        if 1 <= k < N:
+            return k
+        K = _hmac.new(K, V + b"\x00", hashlib.sha256).digest()
+        V = _hmac.new(K, V, hashlib.sha256).digest()
+
+
 def sign(d: int, msg: bytes) -> bytes:
     """ECDSA-SHA256, low-S normalized, 64-byte r||s big-endian."""
+    if not _HAVE_OPENSSL:
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        while True:
+            k = _rfc6979_k(d, z)
+            pt = pt_mul(k, (GX, GY))
+            if pt is None:
+                continue
+            r = pt[0] % N
+            if r == 0:
+                continue
+            s = (z + r * d) * pow(k, N - 2, N) % N
+            if s == 0:
+                continue
+            if s > HALF_N:
+                s = N - s
+            return r.to_bytes(32, "big") + s.to_bytes(32, "big")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (
@@ -144,6 +193,8 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     s = int.from_bytes(sig[32:], "big")
     if not (1 <= r < N and 1 <= s <= HALF_N):
         return False
+    if not _HAVE_OPENSSL:
+        return verify_py(pub, msg, sig)
     from cryptography.exceptions import InvalidSignature
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec

@@ -13,7 +13,12 @@ from __future__ import annotations
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+except ImportError:  # pure-Python RFC 8439 fallback
+    from cometbft_tpu.crypto.aead_ref import ChaCha20Poly1305
 
 KEY_SIZE = 32
 NONCE_SIZE = 24

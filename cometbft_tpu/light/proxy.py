@@ -31,7 +31,14 @@ class LightProxy:
                  trusted_height: int = 0, trusted_hash: bytes = b"",
                  trusting_period: float = 14 * 24 * 3600.0,
                  host: str = "127.0.0.1", port: int = 0,
-                 batch_fn=None, db_path: Optional[str] = None):
+                 batch_fn=None, db_path: Optional[str] = None,
+                 insecure_allow_reroot: bool = False):
+        """insecure_allow_reroot: permit trust-on-first-use RE-rooting
+        when a persisted trust root has expired and no --trusted-hash
+        is pinned. Off by default: silently letting the primary pick a
+        fresh root after downtime is exactly the long-range attack the
+        trusting period exists to stop (the reference errors out and
+        demands fresh TrustOptions)."""
         from cometbft_tpu.light.client import Client
 
         self.chain_id = chain_id
@@ -58,6 +65,7 @@ class LightProxy:
             )
         self._trusted_height = trusted_height
         self._trusted_hash = trusted_hash
+        self._insecure_allow_reroot = insecure_allow_reroot
         self._boot_lock = threading.Lock()
         self.httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
         self.httpd.proxy = self  # type: ignore[attr-defined]
@@ -85,10 +93,24 @@ class LightProxy:
                 # persisted root older than the trusting period: it can
                 # no longer anchor verification. Re-bootstrap from the
                 # operator's TrustOptions if given (the reference's
-                # restart-after-downtime path); without them fall
-                # through to the TOFU warning rather than wedging.
+                # restart-after-downtime path). Without a pinned hash
+                # this is an ERROR — silently re-rooting on whatever
+                # the primary serves would let a lying primary rewrite
+                # history past the trusting period (round-5 advisory;
+                # the reference requires fresh TrustOptions here).
                 import logging
 
+                if not self._trusted_hash and \
+                        not self._insecure_allow_reroot:
+                    raise LightProxyError(
+                        f"persisted trust root at height "
+                        f"{latest.height} is older than the trusting "
+                        f"period and no --trusted-hash is pinned; "
+                        f"refusing to re-root trust on the primary. "
+                        f"Pin --trusted-height/--trusted-hash from an "
+                        f"out-of-band source (or pass "
+                        f"insecure_allow_reroot to accept the risk)."
+                    )
                 logging.getLogger(__name__).warning(
                     "light proxy: persisted trust root at height %d has "
                     "expired; re-bootstrapping from trust options",

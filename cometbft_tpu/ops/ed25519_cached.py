@@ -206,16 +206,20 @@ class ValsetTable:
     with the table instead of riding every per-commit row batch."""
 
     def __init__(self, tab, ok, power5, n_vals: int,
-                 pub_digest: Optional[np.ndarray] = None,
+                 pubs_host: Optional[tuple] = None,
                  powers_host: Optional[np.ndarray] = None):
         self.tab = tab          # (M/128 * 8192, 128) int16, device
         self.ok = ok            # (M,) bool, device
         self.power5 = power5    # (M, POWER_LIMBS) int32, device
         self.n_vals = n_vals
-        # per-slot 8-byte pubkey digests + host power copy — lets
+        # per-slot ACTUAL pubkey bytes + host power copy — lets
         # table_for_pubs find a near-miss cached table and compute the
-        # exact (pubkey, power) delta without a device round trip
-        self.pub_digest = pub_digest
+        # exact (pubkey, power) delta without a device round trip.
+        # Full bytes, not digests: the round-5 advisory showed an
+        # 8-byte unkeyed digest lets a 2^32-work birthday collision
+        # pin a retired key into cached tables (the reference likewise
+        # compares whole keys in updateWithChangeSet).
+        self.pubs_host = pubs_host
         self.powers_host = powers_host
 
 
@@ -224,13 +228,11 @@ def table_pad(n: int) -> int:
     return max(128, ek.bucket_size(max(n, 1)))
 
 
-def _pub_digests(pub_bytes: Sequence[bytes], padded: int) -> np.ndarray:
-    d = np.zeros((padded,), np.uint64)
-    for i, p in enumerate(pub_bytes):
-        d[i] = np.frombuffer(
-            hashlib.blake2b(p, digest_size=8).digest(), np.uint64
-        )[0]
-    return d
+def _pubs_host(pub_bytes: Sequence[bytes], padded: int) -> tuple:
+    """Padded per-slot pubkey bytes (b"" for dead slots)."""
+    out = list(pub_bytes[:padded])
+    out.extend(b"" for _ in range(padded - len(out)))
+    return tuple(out)
 
 
 def _power_dev(powers, padded: int):
@@ -270,7 +272,7 @@ def build_table(pub_bytes: Sequence[bytes],
     ok = ok & jnp.asarray(lenok)
     return ValsetTable(_blocked_i16(tbl), ok,
                        _power_dev(powers, padded),
-                       padded, _pub_digests(pub_bytes, padded),
+                       padded, _pubs_host(pub_bytes, padded),
                        _powers_host(powers, padded))
 
 
@@ -372,19 +374,18 @@ def update_table(table: ValsetTable, changes,
         jnp.asarray(asign), jnp.asarray(lenok), jnp.asarray(idxs),
         jnp.asarray(sel), jnp.asarray(new_p5), jnp.asarray(psel),
     )
-    dig = None
-    if table.pub_digest is not None:
-        dig = table.pub_digest.copy()
+    pubs_host = None
+    if table.pubs_host is not None:
+        lst = list(table.pubs_host)
         for (i, p) in changes:
-            dig[i] = np.frombuffer(
-                hashlib.blake2b(p, digest_size=8).digest(), np.uint64
-            )[0]
+            lst[i] = p
+        pubs_host = tuple(lst)
     ph = None
     if table.powers_host is not None:
         ph = table.powers_host.copy()
         for i, pw in pw_items:
             ph[i] = pw
-    return ValsetTable(tab, ok, power5, table.n_vals, dig, ph)
+    return ValsetTable(tab, ok, power5, table.n_vals, pubs_host, ph)
 
 
 # LRU of built tables keyed by the pubkey list (order-sensitive: the
@@ -421,32 +422,35 @@ def table_for_pubs(pub_bytes: Sequence[bytes],
             _TABLE_CACHE.move_to_end(key)
             return t
         # near-miss scan: same padded size, few changed slots -> update
-        # the cached table incrementally (valset churn between epochs)
+        # the cached table incrementally (valset churn between epochs).
+        # The delta compares FULL pubkey bytes — a digest here would
+        # make cache reuse collidable (round-5 advisory high).
         base = None
         padded = table_pad(len(pub_bytes))
-        digs = _pub_digests(pub_bytes, padded)
+        target = _pubs_host(pub_bytes, padded)
         for cand in reversed(_TABLE_CACHE.values()):
-            if cand.n_vals != padded or cand.pub_digest is None:
+            if cand.n_vals != padded or cand.pubs_host is None:
                 continue
-            diff = np.nonzero(cand.pub_digest != digs)[0]
-            if diff.size <= MAX_INCREMENTAL:
+            diff = [i for i in range(padded)
+                    if cand.pubs_host[i] != target[i]]
+            if len(diff) <= MAX_INCREMENTAL:
                 base = (cand, diff)
                 break
     t = None
     if base is not None:
         cand, diff = base
-        changes = [(int(i), pub_bytes[i] if i < len(pub_bytes) else b"")
-                   for i in diff]
-        pw_map = None
-        if powers is not None:
-            # only CHANGED powers ride the update (the full map
-            # crashed update_table's slot budget for valsets > 128 and
-            # rewrote every power row)
-            new_ph = _powers_host(powers, padded)
-            old_ph = (cand.powers_host if cand.powers_host is not None
-                      else np.zeros((padded,), np.int64))
-            pw_map = {int(i): int(new_ph[i])
-                      for i in np.nonzero(new_ph != old_ph)[0]}
+        changes = [(int(i), target[i]) for i in diff]
+        # only CHANGED powers ride the update (the full map crashed
+        # update_table's slot budget for valsets > 128 and rewrote
+        # every power row). powers=None means ZERO powers — same as a
+        # cold build_table(pubs, None) — so tally semantics never
+        # depend on whether the lookup hit the near-miss cache
+        # (round-5 advisory low).
+        new_ph = _powers_host(powers, padded)
+        old_ph = (cand.powers_host if cand.powers_host is not None
+                  else np.zeros((padded,), np.int64))
+        pw_map = {int(i): int(new_ph[i])
+                  for i in np.nonzero(new_ph != old_ph)[0]}
         try:
             t = update_table(cand, changes, pw_map)
         except ValueError:

@@ -17,17 +17,31 @@ import os
 import struct
 from typing import Tuple
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pure-Python fallback (crypto/aead_ref.py)
+    from cometbft_tpu.crypto.aead_ref import (  # noqa: F401
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hkdf_sha256,
+    )
+
+    _HAVE_OPENSSL = False
 
 from cometbft_tpu.crypto.keys import PrivKey, PubKey
 
@@ -43,11 +57,17 @@ class HandshakeError(Exception):
 
 def _kdf(shared: bytes, lo_pub: bytes, hi_pub: bytes) -> Tuple[bytes, bytes, bytes]:
     """Derive (key_lo_to_hi, key_hi_to_lo, challenge) from the ECDH secret
-    and the sorted ephemeral pubkeys."""
-    okm = HKDF(
-        algorithm=hashes.SHA256(), length=96,
-        salt=b"CBT_TPU_SECRET_CONNECTION", info=lo_pub + hi_pub,
-    ).derive(shared)
+    and the sorted ephemeral pubkeys. Both backends compute the SAME
+    RFC 5869 HKDF-SHA256 — an OpenSSL node and a pure-Python node
+    handshake with each other."""
+    if _HAVE_OPENSSL:
+        okm = HKDF(
+            algorithm=hashes.SHA256(), length=96,
+            salt=b"CBT_TPU_SECRET_CONNECTION", info=lo_pub + hi_pub,
+        ).derive(shared)
+    else:
+        okm = hkdf_sha256(shared, b"CBT_TPU_SECRET_CONNECTION",
+                          lo_pub + hi_pub, 96)
     return okm[:32], okm[32:64], okm[64:]
 
 
@@ -77,9 +97,12 @@ class SecretConnection:
            encrypted channel; verify the peer's signature
         """
         eph = X25519PrivateKey.generate()
-        eph_pub = eph.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw
-        )
+        if _HAVE_OPENSSL:
+            eph_pub = eph.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+        else:
+            eph_pub = eph.public_key().public_bytes_raw()
         stream.sendall(eph_pub)
         their_eph = _read_exact(stream, 32)
         shared = eph.exchange(X25519PublicKey.from_public_bytes(their_eph))

@@ -41,11 +41,24 @@ class AddrBook:
     addrbook.go:854-947) written on every mark_good, on a periodic
     timer in the PEX reactor, and at shutdown — so a crash loses at
     most the newest gossip, not the tried set.
+
+    Dial failures NEVER delete entries (round-5 advisory: the old
+    delete-after-5-failures behavior let a few seconds of total
+    unreachability irreversibly empty the persisted book, operator
+    seeds included — the reference only evicts under capacity pressure
+    or markBad, never on failure alone). Instead, each failure backs
+    the entry off exponentially (attempts capped at MAX_ATTEMPTS for
+    the backoff exponent) and `pick` skips it until the cooldown
+    lapses; repeated failures demote old->new, and only the gossip
+    eviction path may drop the most-failed NEW entries over capacity.
+    Operator seeds (`seed=True` on add) are exempt even from that.
     """
 
     MAX_NEW = 1024          # eviction cap for the unproven tier
-    MAX_ATTEMPTS = 5        # new entries over this are dropped;
-                            # old entries are demoted back to new
+    MAX_ATTEMPTS = 5        # backoff-exponent cap; old entries demote
+                            # to new past it (never deleted)
+    BACKOFF_BASE = 2.0      # cooldown after the 1st failed dial
+    BACKOFF_MAX = 600.0     # cap: even a dead address retries each 10m
 
     def __init__(self, path: Optional[str] = None,
                  max_per_source: int = 50):
@@ -66,6 +79,10 @@ class AddrBook:
             doc = json.load(f)
         for e in doc.get("addrs", []):
             e.setdefault("bucket", "new")
+            e.setdefault("seed", False)
+            # cooldowns don't survive a restart: the ensure routine
+            # should redial the whole persisted book immediately
+            e["next_dial"] = 0.0
             self._addrs[e["id"]] = e
 
     def save(self) -> None:
@@ -80,9 +97,14 @@ class AddrBook:
                 json.dump(doc, f)
             os.replace(tmp, self.path)
 
-    def add(self, addr: NetAddress, source: str = "") -> bool:
+    def add(self, addr: NetAddress, source: str = "",
+            seed: bool = False) -> bool:
         with self._lock:
             if addr.node_id in self._addrs:
+                if seed:
+                    # re-declared operator seed: upgrade in place so a
+                    # gossip-learned copy can't shed the protection
+                    self._addrs[addr.node_id]["seed"] = True
                 return False
             n_from_source = sum(
                 1 for e in self._addrs.values()
@@ -93,15 +115,19 @@ class AddrBook:
             self._addrs[addr.node_id] = {
                 "id": addr.node_id, "host": addr.host, "port": addr.port,
                 "src": source, "attempts": 0, "last_success": 0.0,
-                "banned": False, "bucket": "new",
+                "banned": False, "bucket": "new", "seed": seed,
+                "next_dial": 0.0,
             }
             self._evict_new_locked()
             return True
 
     def _evict_new_locked(self) -> None:
         """Cap the unproven tier (addrbook.go expireNew): drop the
-        most-failed, then oldest, new entries over MAX_NEW."""
-        news = [e for e in self._addrs.values() if e["bucket"] == "new"]
+        most-failed, then oldest, new entries over MAX_NEW. Operator
+        seeds are never evicted — they are the redial set of last
+        resort."""
+        news = [e for e in self._addrs.values()
+                if e["bucket"] == "new" and not e.get("seed")]
         if len(news) <= self.MAX_NEW:
             return
         news.sort(key=lambda e: (-e["attempts"], e["last_success"]))
@@ -116,6 +142,7 @@ class AddrBook:
             e = self._addrs.get(node_id)
             if e:
                 e["attempts"] = 0
+                e["next_dial"] = 0.0
                 e["last_success"] = time.time()
                 promoted = e["bucket"] != "old"
                 e["bucket"] = "old"
@@ -125,21 +152,25 @@ class AddrBook:
             self.save()
 
     def mark_attempt(self, node_id: str) -> None:
+        """Failed (or started) dial: back off, never delete. The entry
+        stays in the book with a cooldown of BACKOFF_BASE * 2^attempts
+        (capped), so transient total unreachability — a restart into a
+        partitioned network — costs minutes of patience, not the book."""
         with self._lock:
             e = self._addrs.get(node_id)
             if not e:
                 return
-            e["attempts"] += 1
-            if e["attempts"] > self.MAX_ATTEMPTS:
-                if e["bucket"] == "old":
-                    # repeatedly unreachable tried peer: demote with a
-                    # reset attempt count (addrbook.go moveToNew on
-                    # eviction) — it stays dialable at new-tier priority
-                    # and is dropped if it keeps failing
-                    e["bucket"] = "new"
-                    e["attempts"] = 0
-                else:
-                    del self._addrs[node_id]
+            e["attempts"] = min(e["attempts"] + 1, self.MAX_ATTEMPTS)
+            e["next_dial"] = time.time() + min(
+                self.BACKOFF_BASE * (2 ** (e["attempts"] - 1)),
+                self.BACKOFF_MAX,
+            )
+            if e["attempts"] >= self.MAX_ATTEMPTS and \
+                    e["bucket"] == "old" and not e.get("seed"):
+                # repeatedly unreachable tried peer: demote so gossip
+                # churn can eventually displace it (addrbook.go
+                # moveToNew on eviction) — still dialable, never lost
+                e["bucket"] = "new"
 
     def mark_bad(self, node_id: str) -> None:
         with self._lock:
@@ -151,13 +182,15 @@ class AddrBook:
              bias_new: float = 0.3) -> Optional[NetAddress]:
         """Random dialable address (addrbook.go:303 PickAddress):
         choose the tried tier with prob 1-bias_new, then a low-attempt
-        candidate at random within the tier."""
+        candidate at random within the tier. Backed-off entries are
+        skipped until their cooldown lapses."""
         exclude = exclude or set()
+        now = time.time()
         with self._lock:
             cands = [
                 e for e in self._addrs.values()
                 if not e["banned"] and e["id"] not in exclude
-                and e["attempts"] < self.MAX_ATTEMPTS
+                and e.get("next_dial", 0.0) <= now
             ]
         if not cands:
             return None

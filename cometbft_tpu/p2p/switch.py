@@ -13,12 +13,16 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from cometbft_tpu.p2p.key import NetAddress, NodeInfo, NodeKey
 from cometbft_tpu.p2p.transport import Transport, UpgradedConn
 
 _log = logging.getLogger(__name__)
+
+fp.register("p2p.dial",
+            "outbound dial about to start (raise/flake = dial failure)")
 
 
 class Reactor:
@@ -154,6 +158,7 @@ class Switch(BaseService):
             if addr.node_id in self.peers:
                 return
         try:
+            fp.fail_point("p2p.dial")
             self.transport.dial(addr)
         except Exception as e:  # noqa: BLE001
             _log.warning("dial %s failed: %s", addr, e)
@@ -184,6 +189,7 @@ class Switch(BaseService):
                     have = node_id in self.peers
                 if not have:
                     try:
+                        fp.fail_point("p2p.dial")
                         self.transport.dial(addr)
                     except Exception:  # noqa: BLE001
                         pass

@@ -10,10 +10,15 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
 from cometbft_tpu.p2p.key import NetAddress, NodeInfo, NodeKey
 
 HANDSHAKE_TIMEOUT = 10.0
+
+fp.register("p2p.handshake",
+            "secret-conn established, NodeInfo not yet exchanged "
+            "(raise = mid-handshake connection drop)")
 
 
 class TransportError(Exception):
@@ -95,6 +100,7 @@ class Transport:
                  ) -> UpgradedConn:
         raw.settimeout(HANDSHAKE_TIMEOUT)
         sconn = SecretConnection.handshake(raw, self.node_key.priv_key)
+        fp.fail_point("p2p.handshake")
         # authenticate the dialed ID against the secret-conn identity
         # (transport.go upgrade: ErrRejected w/ isAuthFailure)
         actual_id = sconn.remote_pub.address().hex()

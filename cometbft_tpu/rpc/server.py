@@ -507,6 +507,11 @@ class Routes:
         if isinstance(seeds, str):
             seeds = json.loads(seeds)
         for a in self._addrs_arg(seeds or []):
+            # operator-supplied seeds are protected in the address book:
+            # dial failures back them off but can never evict them
+            book = getattr(self.node, "addr_book", None)
+            if book is not None:
+                book.add(a, seed=True)
             self.node.switch.dial_peer(a, persistent=False)
         return {"log": f"dialing seeds in progress: {seeds}"}
 

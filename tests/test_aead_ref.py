@@ -1,0 +1,124 @@
+"""Pure-Python crypto fallbacks (crypto/aead_ref.py + the gated paths
+in keys.py / secp256k1_ref.py): RFC-vector pinned so the no-OpenSSL
+degraded mode stays byte-compatible with the OpenSSL-backed one."""
+import pytest
+
+from cometbft_tpu.crypto import aead_ref
+
+
+def test_x25519_rfc7748_vectors():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd"
+        "62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c"
+        "726624ec26b3353b10a903a6d0ab1c4c")
+    assert aead_ref._x25519_scalarmult(k, u).hex() == (
+        "c3da55379de9c6908e94ea4df28d084f"
+        "32eccf03491c71f754b4075577a28552")
+    # DH agreement (RFC 7748 §6.1): Alice's key pair + the published
+    # Bob PUBLIC key pin the shared secret K
+    ka = bytes.fromhex("77076d0a7318a57d3c16c17251b26645"
+                       "df4c2f87ebc0992ab177fba51db92c2a")
+    pa = aead_ref.X25519PrivateKey(ka).public_key()
+    assert pa.public_bytes_raw().hex() == (
+        "8520f0098930a754748b7ddcb43ef75a"
+        "0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    pb = aead_ref.X25519PublicKey.from_public_bytes(bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece43537"
+        "3f8343c85b78674dadfc7e146f882b4f"))
+    sa = aead_ref.X25519PrivateKey(ka).exchange(pb)
+    assert sa.hex() == ("4a5d9d5ba4ce2de1728e3bf480350f25"
+                        "e07e21c947d19e3376f09b3c1e161742")
+    # fresh-keypair agreement property
+    x, y = (aead_ref.X25519PrivateKey.generate() for _ in range(2))
+    assert x.exchange(y.public_key()) == y.exchange(x.public_key())
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (b"Ladies and Gentlemen of the class of '99: If I could "
+          b"offer you only one tip for the future, sunscreen would "
+          b"be it.")
+    a = aead_ref.ChaCha20Poly1305(key)
+    ct = a.encrypt(nonce, pt, aad)
+    assert ct[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+    assert a.decrypt(nonce, ct, aad) == pt
+    with pytest.raises(aead_ref.InvalidTag):
+        a.decrypt(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), aad)
+    with pytest.raises(aead_ref.InvalidTag):
+        a.decrypt(nonce, ct, b"wrong-aad")
+
+
+def test_hkdf_rfc5869_case1():
+    okm = aead_ref.hkdf_sha256(
+        ikm=b"\x0b" * 22,
+        salt=bytes.fromhex("000102030405060708090a0b0c"),
+        info=bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"),
+        length=42,
+    )
+    assert okm.hex() == (
+        "3cb25f25faacd57a90434f64d0362f2a"
+        "2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865")
+
+
+def test_ed25519_sign_fallback_matches_ref():
+    """PrivKey signing (whatever backend) must equal the RFC 8032
+    reference implementation bit-for-bit."""
+    from cometbft_tpu.crypto import ed25519_ref as ed
+    from cometbft_tpu.crypto.keys import PrivKey
+
+    seed = b"\x3c" * 32
+    pk = PrivKey.generate(seed)
+    assert pk.pub_key().data == ed.pubkey_from_seed(seed)
+    for msg in (b"", b"x", b"hello world" * 100):
+        assert pk.sign(msg) == ed.sign(seed, msg)
+
+
+def test_secp256k1_sign_verify_roundtrip():
+    """The host signer (OpenSSL or RFC 6979 fallback) produces low-S
+    signatures the pure oracle accepts."""
+    from cometbft_tpu.crypto import secp256k1_ref as s
+
+    d = 0x1234_5678_9ABC_DEF0_1111
+    pub = s.pubkey_from_secret(d)
+    assert len(pub) == 33 and pub[0] in (2, 3)
+    sig = s.sign(d, b"fallback")
+    assert int.from_bytes(sig[32:], "big") <= s.HALF_N
+    assert s.verify(pub, b"fallback", sig)
+    assert s.verify_py(pub, b"fallback", sig)
+    assert not s.verify(pub, b"other", sig)
+
+
+def test_secret_connection_over_fallback_or_openssl():
+    """The STS handshake works with whichever AEAD backend is loaded
+    (socketpair round trip incl. multi-frame messages + tamper)."""
+    import socket
+    import threading
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+
+    a, b = socket.socketpair()
+    pva = PrivKey.generate(b"\x01" * 32)
+    pvb = PrivKey.generate(b"\x02" * 32)
+    res = {}
+    t = threading.Thread(
+        target=lambda: res.update(s=SecretConnection.handshake(b, pvb))
+    )
+    t.start()
+    ca = SecretConnection.handshake(a, pva)
+    t.join(timeout=10)
+    cb = res["s"]
+    assert ca.remote_pub.data == pvb.pub_key().data
+    assert cb.remote_pub.data == pva.pub_key().data
+    msg = b"ping" * 700  # > 2 frames
+    ca.write_msg(msg)
+    assert cb.read_msg() == msg
+    cb.write_msg(b"")
+    assert ca.read_msg() == b""
+    a.close()
+    b.close()

@@ -25,13 +25,18 @@ def test_cache_key_injective_over_lengths():
     assert ec._cache_key([b"k"], [5]) != ec._cache_key([b"k"], [6])
 
 
-def test_pub_digest_delta_detection():
+def test_pubs_host_delta_detection():
+    """The near-miss delta scan compares FULL pubkey bytes (the digest
+    comparison it replaced was birthday-collidable at 2^32 work —
+    round-5 advisory high)."""
     pubs = pubs_n(130)
-    d1 = ec._pub_digests(pubs, 256)
+    h1 = ec._pubs_host(pubs, 256)
     pubs2 = list(pubs)
     pubs2[77] = ed.pubkey_from_seed(b"\x99" * 32)
-    d2 = ec._pub_digests(pubs2, 256)
-    assert list(np.nonzero(d1 != d2)[0]) == [77]
+    h2 = ec._pubs_host(pubs2, 256)
+    assert [i for i in range(256) if h1[i] != h2[i]] == [77]
+    # padding slots are empty and equal
+    assert h1[255] == b"" and len(h1) == 256
 
 
 def test_pack_rows_layout():
@@ -70,7 +75,7 @@ def test_update_table_budget_errors():
     """Deltas beyond UPDATE_PAD raise ValueError (table_for_pubs turns
     that into a full rebuild) and out-of-range indices are rejected."""
     t = ec.ValsetTable(None, None, None, 256,
-                       ec._pub_digests([], 256),
+                       ec._pubs_host([], 256),
                        np.zeros(256, np.int64))
     with pytest.raises(ValueError):
         ec.update_table(t, [(300, b"\x00" * 32)])

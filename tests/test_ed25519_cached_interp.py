@@ -1,0 +1,56 @@
+"""Interpret-mode differential for the cached ZIP-215 kernel on CPU.
+
+ADVICE r5 low: the cached-kernel differentials were gated behind
+CBT_TEST_ON_TPU=1, so default CI never exercised the kernel math. This
+file runs the REAL kernel (Pallas interpret mode) at the smallest legal
+shape — one 128-lane tile, one 128-slot table block — with no env gate,
+so `python -m pytest tests/` (the full default suite) enforces the
+oracle differential on any box.
+
+Measured on this 1-core CPU host: ~13.5 min cold (3.5 min XLA compile
+of the table build + ~10 min kernel interpret compile), seconds when
+the persistent compilation cache (conftest.py) is warm. That budget is
+why it carries `slow`: tier-1's `-m 'not slow'` quick gate must not
+spend its 870 s timeout here, while the full suite — and any TPU run —
+still exercises it. The host-side bookkeeping is covered untimed in
+test_ed25519_cached_host.py; full-shape kernel coverage stays in
+test_ed25519_cached.py (TPU).
+"""
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.ops import ed25519_cached as ec
+
+pytestmark = pytest.mark.slow
+
+
+def test_cached_kernel_minimal_shape_vs_oracle():
+    """One tile, one table block: valid rows, tampered sig/msg, S>=L
+    malleability, bad pubkey, small-order identity, non-canonical
+    encodings — all must match the pure-Python ZIP-215 oracle."""
+    n = 12
+    seeds = [bytes([i + 1]) * 32 for i in range(n)]
+    pubs = [ed.pubkey_from_seed(s) for s in seeds]
+    msgs = [b"interp-%d" % i for i in range(n)]
+    sigs = [ed.sign(s, m) for s, m in zip(seeds, msgs)]
+
+    # adversarial rows
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    msgs[4] = msgs[4] + b"tampered"
+    sigs[5] = sigs[5][:32] + int.to_bytes(
+        int.from_bytes(sigs[5][32:], "little") + ed.L, 32, "little"
+    )
+    pubs[6] = b"\xff" * 32                       # undecompressable A
+    ident = ed.pt_compress(ed.IDENT)
+    pubs[7], msgs[7], sigs[7] = ident, b"m", ident + b"\x00" * 32
+    neg_zero = int.to_bytes(1 | (1 << 255), 32, "little")
+    pubs[8], msgs[8], sigs[8] = neg_zero, b"m", neg_zero + b"\x00" * 32
+
+    got = ec.verify_batch_cached(pubs, msgs, sigs)
+    exp = [ed.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert got[0] and got[1] and got[3]
+    assert not got[2] and not got[4] and not got[5] and not got[6]
+    # ZIP-215: small-order identity and -0 encodings ACCEPT
+    assert exp[7] and exp[8]

@@ -1,0 +1,140 @@
+"""Failpoint registry unit tests (libs/failpoints.py, the libs/fail
+analog): arming, actions, trigger counts, spec parsing, crash-handler
+override."""
+import time
+
+import pytest
+
+from cometbft_tpu.libs import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    fp.reset()
+    fp.set_crash_handler(None)
+    yield
+    fp.reset()
+    fp.set_crash_handler(None)
+
+
+def test_unarmed_is_noop():
+    fp.register("t.point", "doc")
+    fp.fail_point("t.point")  # nothing armed: no raise, no delay
+    assert "t.point" in fp.registry().names()
+
+
+def test_raise_action_and_counts():
+    fp.register("t.raise")
+    fp.arm("t.raise", "raise", count=2)
+    for _ in range(2):
+        with pytest.raises(fp.FailpointError):
+            fp.fail_point("t.raise")
+    # self-disarmed after the trigger count
+    fp.fail_point("t.raise")
+    st = fp.registry().stats("t.raise")
+    assert st["fires"] == 2 and st["action"] == ""
+
+
+def test_delay_action():
+    fp.arm("t.delay", "delay", arg=0.05)
+    t0 = time.monotonic()
+    fp.fail_point("t.delay")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_flake_is_deterministic():
+    """flake:3 fires on every 3rd evaluation — no RNG anywhere."""
+    fp.arm("t.flake", "flake", arg=3)
+    fired = []
+    for i in range(9):
+        try:
+            fp.fail_point("t.flake")
+            fired.append(False)
+        except fp.FailpointError:
+            fired.append(True)
+    assert fired == [False, False, True] * 3
+
+
+def test_crash_handler_override():
+    crashes = []
+    fp.set_crash_handler(lambda name: crashes.append(name))
+    fp.arm("t.crash", "crash", count=1)
+    fp.fail_point("t.crash")
+    assert crashes == ["t.crash"]
+    fp.fail_point("t.crash")  # count exhausted
+    assert crashes == ["t.crash"]
+
+
+def test_simulated_crash_handler():
+    fp.set_crash_handler(fp.simulated_crash)
+    fp.arm("t.simcrash", "crash")
+    with pytest.raises(fp.SimulatedCrash):
+        fp.fail_point("t.simcrash")
+
+
+def test_spec_parse_and_arm():
+    spec = "a.b=crash*1; c.d=delay:0.5 ;e.f=flake:4*2"
+    assert fp.parse_spec(spec) == [
+        ("a.b", "crash", 0.0, 1),
+        ("c.d", "delay", 0.5, -1),
+        ("e.f", "flake", 4.0, 2),
+    ]
+    assert fp.arm_from_spec(spec) == 3
+    assert fp.registry().stats("c.d")["action"] == "delay"
+
+
+def test_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        fp.parse_spec("no-equals-sign")
+    with pytest.raises(ValueError):
+        fp.parse_spec("a.b=explode")
+    with pytest.raises(ValueError):
+        fp.arm("x", "explode")
+
+
+def test_disarm_and_reset():
+    fp.arm("t.x", "raise")
+    fp.disarm("t.x")
+    fp.fail_point("t.x")
+    fp.arm("t.x", "raise")
+    fp.arm("t.y", "raise")
+    fp.reset()
+    fp.fail_point("t.x")
+    fp.fail_point("t.y")
+
+
+def test_instrumented_seams_registered():
+    """Every seam the ISSUE names is a registered, discoverable point."""
+    import cometbft_tpu.blocksync.pool  # noqa: F401
+    import cometbft_tpu.blocksync.reactor  # noqa: F401
+    import cometbft_tpu.consensus.state  # noqa: F401
+    import cometbft_tpu.consensus.wal  # noqa: F401
+    import cometbft_tpu.crypto.batch  # noqa: F401
+    import cometbft_tpu.p2p.switch  # noqa: F401
+    import cometbft_tpu.p2p.transport  # noqa: F401
+
+    names = fp.registry().names()
+    for expected in (
+        "wal.pre_write", "wal.post_write", "wal.pre_fsync",
+        "wal.mid_rotate",
+        "consensus.wal.pre_vote", "consensus.wal.post_vote",
+        "consensus.wal.pre_proposal", "consensus.wal.post_proposal",
+        "consensus.pre_finalize", "consensus.post_block_save",
+        "blocksync.request", "blocksync.deliver", "blocksync.process",
+        "p2p.dial", "p2p.handshake",
+        "crypto.device_dispatch",
+    ):
+        assert expected in names, f"failpoint {expected} not registered"
+
+
+def test_config_spec_validation():
+    from cometbft_tpu.config.config import Config, ConfigError
+
+    cfg = Config()
+    cfg.failpoints.spec = "wal.pre_fsync=crash*1"
+    cfg.validate_basic()  # parses cleanly, does NOT arm
+    assert fp.registry().stats("wal.pre_fsync") is None or \
+        fp.registry().stats("wal.pre_fsync")["action"] == ""
+    cfg.failpoints.spec = "wal.pre_fsync=explode"
+    with pytest.raises(ConfigError):
+        cfg.validate_basic()

@@ -311,3 +311,96 @@ def test_client_resumes_from_persisted_trust(tmp_path):
     # and the new verification persisted too
     c2.store.close()
     assert DBStore(path).latest().height == 30
+
+
+def test_proxy_refuses_expired_root_without_pinned_hash(tmp_path):
+    """ADVICE r5 low: a light proxy whose PERSISTED trust root has aged
+    past the trusting period must refuse to silently re-root on the
+    primary (trust-on-first-use) unless the operator explicitly opted
+    into the insecure mode or pinned a hash."""
+    from cometbft_tpu.light.proxy import LightProxy, LightProxyError
+    from cometbft_tpu.light.store import DBStore
+
+    keys = keys_for(21, 3)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    path = str(tmp_path / "light.db")
+    st = DBStore(path)
+    st.save(chain.blocks[3])  # T0-era root: years older than 14 days
+    st.close()
+
+    proxy = LightProxy(
+        CHAIN_ID, "http://127.0.0.1:1",  # never contacted
+        db_path=path,
+    )
+    try:
+        with pytest.raises(LightProxyError, match="trusting period"):
+            proxy._ensure_trust()
+    finally:
+        proxy.httpd.server_close()
+
+
+def test_proxy_reroots_expired_root_when_explicitly_insecure(tmp_path):
+    """The escape hatch: insecure_allow_reroot=True restores the old
+    TOFU-with-warning behavior for dev setups."""
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.light.store import DBStore
+
+    keys = keys_for(22, 3)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    path = str(tmp_path / "light.db")
+    st = DBStore(path)
+    st.save(chain.blocks[3])
+    st.close()
+
+    proxy = LightProxy(
+        CHAIN_ID, "http://127.0.0.1:1",
+        trusted_height=5,
+        db_path=path,
+        insecure_allow_reroot=True,
+    )
+    try:
+        # serve the "primary" from the in-process chain: the proxy
+        # re-roots on its height-5 block without raising
+        proxy.client.primary = chain.provider()
+        proxy._ensure_trust()
+        assert proxy.client.store.latest().height == 5
+    finally:
+        proxy.httpd.server_close()
+
+
+def test_proxy_accepts_pinned_hash_reroot(tmp_path):
+    """An operator-pinned --trusted-hash re-roots an expired store
+    securely (and a WRONG pin is rejected)."""
+    from cometbft_tpu.light.proxy import LightProxy, LightProxyError
+    from cometbft_tpu.light.store import DBStore
+
+    keys = keys_for(23, 3)
+    chain = LightChain({h: keys for h in range(1, 6)})
+    path = str(tmp_path / "light.db")
+    st = DBStore(path)
+    st.save(chain.blocks[2])
+    st.close()
+
+    good = chain.blocks[4].signed_header.header.hash()
+    proxy = LightProxy(
+        CHAIN_ID, "http://127.0.0.1:1",
+        trusted_height=4, trusted_hash=good, db_path=path,
+    )
+    try:
+        proxy.client.primary = chain.provider()
+        proxy._ensure_trust()
+        assert proxy.client.store.latest().height == 4
+    finally:
+        proxy.httpd.server_close()
+
+    proxy2 = LightProxy(
+        CHAIN_ID, "http://127.0.0.1:1",
+        trusted_height=4, trusted_hash=b"\x13" * 32,
+        db_path=str(tmp_path / "light2.db"),
+    )
+    try:
+        proxy2.client.primary = chain.provider()
+        with pytest.raises(LightProxyError, match="mismatch"):
+            proxy2._ensure_trust()
+    finally:
+        proxy2.httpd.server_close()

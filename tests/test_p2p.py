@@ -278,3 +278,55 @@ def test_partitioned_node_rejoins(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_dial_and_handshake_failpoints_recover():
+    """p2p.dial / p2p.handshake failpoints: injected dial failures and
+    mid-handshake drops must not wedge the switch — once the fault
+    clears (count exhausted), the same dial succeeds."""
+    from cometbft_tpu.libs import failpoints as fp
+
+    from cometbft_tpu.p2p.switch import Reactor
+
+    class Chan(Reactor):
+        def __init__(self):
+            super().__init__("CHAN")
+
+        def channel_descriptors(self):
+            return [ChannelDescriptor(0x70)]
+
+    fp.reset()
+    ka, kb = NodeKey(PrivKey.generate(b"\x1a" * 32)), \
+        NodeKey(PrivKey.generate(b"\x1b" * 32))
+    sa, sb = Switch(ka, "net-fp"), Switch(kb, "net-fp")
+    sa.add_reactor(Chan())
+    sb.add_reactor(Chan())
+    addr_a = sa.listen()
+    sa.start(); sb.start()
+    try:
+        # dial failpoint: dials die before the socket op
+        fp.arm("p2p.dial", "raise")
+        sb.dial_peer(addr_a, persistent=False)
+        sb.dial_peer(addr_a, persistent=False)
+        assert sb.num_peers() == 0
+        fp.disarm("p2p.dial")
+
+        # handshake failpoint: secret conn established then dropped on
+        # BOTH sides (the registry is process-global) — everybody must
+        # clean up, nobody crashes
+        fp.arm("p2p.handshake", "raise")
+        sb.dial_peer(addr_a, persistent=False)
+        time.sleep(0.5)
+        assert sb.num_peers() == 0 and sa.num_peers() == 0
+        fp.disarm("p2p.handshake")
+
+        # fault cleared: the very same dial connects
+        sb.dial_peer(addr_a, persistent=False)
+        deadline = time.time() + 10
+        while sa.num_peers() < 1 or sb.num_peers() < 1:
+            assert time.time() < deadline, \
+                "recovery dial never connected"
+            time.sleep(0.02)
+    finally:
+        fp.reset()
+        sa.stop(); sb.stop()

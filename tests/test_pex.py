@@ -81,7 +81,9 @@ def test_pex_discovers_third_node(tmp_path):
 
 def test_addrbook_buckets_promote_demote(tmp_path):
     """addrbook.go new/old tiers: mark_good promotes (and persists
-    eagerly), repeated failed attempts demote old->new and drop new."""
+    eagerly); repeated failed attempts demote old->new but NEVER delete
+    (delete-on-failure was the round-5 advisory bug: transient total
+    unreachability emptied the persisted book)."""
     path = str(tmp_path / "book.json")
     book = AddrBook(path)
     aid, bid = "aa" * 20, "bb" * 20
@@ -93,14 +95,60 @@ def test_addrbook_buckets_promote_demote(tmp_path):
     # eager persistence on promote: a crash right now still redials A
     assert AddrBook(path)._addrs[aid]["bucket"] == "old"
 
-    # old demotes to new after MAX_ATTEMPTS+1 failures
+    # old demotes to new after MAX_ATTEMPTS failures
     for _ in range(AddrBook.MAX_ATTEMPTS + 1):
         book.mark_attempt(aid)
     assert book._addrs[aid]["bucket"] == "new"
-    # new entries get dropped outright
-    for _ in range(AddrBook.MAX_ATTEMPTS + 1):
+    # new entries survive any number of failures (backed off, capped)
+    for _ in range(AddrBook.MAX_ATTEMPTS * 3):
         book.mark_attempt(bid)
-    assert bid not in book._addrs
+    assert bid in book._addrs
+    assert book._addrs[bid]["attempts"] == AddrBook.MAX_ATTEMPTS
+
+
+def test_addrbook_backoff_and_seed_retention(tmp_path):
+    """ISSUE acceptance: the book retains operator seeds and redials
+    after transient total unreachability — failures back entries off,
+    cooldown lapse makes them pickable again, and seeds survive both
+    failure storms and new-tier eviction pressure."""
+    book = AddrBook(str(tmp_path / "book.json"))
+    seed_id = "ee" * 20
+    plain_id = "ab" * 20
+    book.add(NetAddress(seed_id, "127.0.0.1", 9), seed=True)
+    book.add(NetAddress(plain_id, "127.0.0.1", 10), source="s")
+
+    # total unreachability: everything fails over and over
+    for _ in range(20):
+        book.mark_attempt(seed_id)
+        book.mark_attempt(plain_id)
+    assert seed_id in book._addrs and plain_id in book._addrs
+    # backed off: not pickable right now
+    assert book.pick() is None
+    # ...but after the cooldown both become dialable again
+    for e in book._addrs.values():
+        e["next_dial"] = time.time() - 1
+    picked = {book.pick().node_id for _ in range(20)}
+    assert seed_id in picked
+    # a success resets the backoff entirely
+    book.mark_good(seed_id)
+    assert book._addrs[seed_id]["attempts"] == 0
+    assert book._addrs[seed_id]["next_dial"] == 0.0
+
+    # persisted backoff does not wedge a restart: cooldowns reset on load
+    book.mark_attempt(plain_id)
+    book.save()
+    book2 = AddrBook(book.path)
+    assert book2._addrs[plain_id]["next_dial"] == 0.0
+    assert book2._addrs[seed_id]["seed"] is True
+
+    # eviction pressure cannot displace the seed even from the new tier
+    # (non-seed gossip entries MAY be evicted under capacity pressure —
+    # that is the one legitimate eviction path)
+    book.MAX_NEW = 2
+    for i in range(8):
+        book.add(NetAddress(f"{i:02x}" * 20, "127.0.0.1", 1000 + i),
+                 source=f"s{i}")
+    assert seed_id in book._addrs
 
 
 def test_addrbook_pick_bias_and_new_eviction(tmp_path):

@@ -3,20 +3,32 @@
 The HChaCha20 core is differentially tested against OpenSSL's ChaCha20
 (the `cryptography` library): HChaCha20's output equals the ChaCha20
 block-function state WITHOUT the feed-forward, so subtracting the
-initial state words from the keystream recovers it exactly.
+initial state words from the keystream recovers it exactly. Only that
+differential needs the wheel — the roundtrip/tamper/length tests run on
+whichever AEAD backend symmetric.py loaded (OpenSSL or the pure-Python
+aead_ref fallback), so the fallback-backed XChaCha path stays covered
+in wheel-less containers.
 """
 import os
 import struct
 
 import pytest
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
 
 from cometbft_tpu.crypto import symmetric as sym
+
+try:
+    from cryptography.exceptions import InvalidTag
+except ImportError:  # no-OpenSSL container: the fallback's exception
+    from cometbft_tpu.crypto.aead_ref import InvalidTag
 
 
 def _hchacha_via_openssl(key: bytes, nonce16: bytes) -> bytes:
     """Independent HChaCha20 from OpenSSL's ChaCha20 keystream."""
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+    )
+
     cipher = Cipher(algorithms.ChaCha20(key, nonce16), mode=None)
     ks = cipher.encryptor().update(b"\x00" * 64)
     ks_words = struct.unpack("<16L", ks)
@@ -29,11 +41,27 @@ def _hchacha_via_openssl(key: bytes, nonce16: bytes) -> bytes:
 
 
 def test_hchacha20_differential_vs_openssl():
+    pytest.importorskip(
+        "cryptography",
+        reason="OpenSSL differential needs the cryptography wheel",
+    )
     rnd = os.urandom
     for _ in range(20):
         key, nonce16 = rnd(32), rnd(16)
         assert sym.hchacha20(key, nonce16) == \
             _hchacha_via_openssl(key, nonce16)
+
+
+def test_hchacha20_cfrg_vector():
+    """draft-irtf-cfrg-xchacha §2.2.1 test vector: pins the subkey
+    derivation with no OpenSSL dependency."""
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f")
+    nonce16 = bytes.fromhex("000000090000004a0000000031415927")
+    assert sym.hchacha20(key, nonce16).hex() == (
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc")
 
 
 def test_seal_open_roundtrip_and_tamper():
