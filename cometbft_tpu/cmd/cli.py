@@ -103,6 +103,7 @@ def build_node(home: str, cfg=None):
         home=os.path.join(home, "data"),
         timeouts=cfg.consensus.timeout_params(),
         batch_fn=cfg.crypto.batch_fn(),
+        verify_plane=cfg.verify_plane,
         p2p=True,
         node_key=NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json")),
         blocksync=cfg.base.blocksync,

@@ -103,6 +103,30 @@ class CryptoConfig:
 
 
 @dataclass
+class VerifyPlaneConfig:
+    """The always-on cross-caller batch-verification scheduler
+    (cometbft_tpu.verifyplane). `enable = true` starts it with the node;
+    every verification consumer (gossiped votes, vote extensions, light
+    client, crypto.batch callers) then coalesces into shared device
+    passes."""
+
+    enable: bool = False
+    window_ms: float = 1.5      # micro-batch deadline (added latency cap)
+    max_batch: int = 1024       # flush early at this many pending rows
+    max_queue: int = 8192       # backpressure above this many rows
+
+    def build(self, metrics=None):
+        """A VerifyPlane per this config, or None when disabled."""
+        if not self.enable:
+            return None
+        from cometbft_tpu.verifyplane import VerifyPlane
+
+        return VerifyPlane(window_ms=self.window_ms,
+                           max_batch=self.max_batch,
+                           max_queue=self.max_queue, metrics=metrics)
+
+
+@dataclass
 class FailpointsConfig:
     """Deterministic fault injection (libs/failpoints.py). `spec` uses
     the same syntax as the CBT_FAILPOINTS env var:
@@ -126,6 +150,8 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    verify_plane: VerifyPlaneConfig = field(
+        default_factory=VerifyPlaneConfig)
     failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
 
     def validate_basic(self) -> None:
@@ -142,6 +168,13 @@ class Config:
             )
         if self.crypto.breaker_cooldown < 0:
             raise ConfigError("[crypto] breaker_cooldown must be >= 0")
+        if self.verify_plane.window_ms < 0:
+            raise ConfigError("[verify_plane] window_ms must be >= 0")
+        if self.verify_plane.max_batch < 1:
+            raise ConfigError("[verify_plane] max_batch must be >= 1")
+        if self.verify_plane.max_queue < self.verify_plane.max_batch:
+            raise ConfigError(
+                "[verify_plane] max_queue must be >= max_batch")
         if self.failpoints.spec:
             # parse-validate without arming: a typo'd spec must fail at
             # config load, not silently never fire
@@ -171,7 +204,8 @@ def _render(cfg: Config) -> str:
     for section, obj in [
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
-        ("crypto", cfg.crypto), ("failpoints", cfg.failpoints),
+        ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
+        ("failpoints", cfg.failpoints),
     ]:
         out.append(f"[{section}]")
         for k, val in vars(obj).items():
@@ -192,7 +226,8 @@ def load_config(path: str) -> Config:
     for section, obj in [
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
-        ("crypto", cfg.crypto), ("failpoints", cfg.failpoints),
+        ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
+        ("failpoints", cfg.failpoints),
     ]:
         for k, val in doc.get(section, {}).items():
             if not hasattr(obj, k):

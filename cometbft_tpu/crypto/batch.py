@@ -198,6 +198,34 @@ def verify_batch(
 ) -> np.ndarray:
     """Verify a (possibly mixed-key-type) batch; (n,) bool validity.
 
+    When the verify plane is running (node-lifecycle scheduler,
+    cometbft_tpu.verifyplane), a default-configured call becomes a
+    submit-and-wait over the plane so independent callers coalesce into
+    shared device passes. Calls that pin kernels/breaker (tests, the
+    plane's own dispatcher) keep the direct path.
+    """
+    if kernels is None and breaker is None:
+        from cometbft_tpu.verifyplane import plane as _vp
+
+        p = _vp.global_plane()
+        if p is not None:
+            try:
+                return p.submit_and_wait(pubs, msgs, sigs)
+            except _vp.PlaneError:
+                pass  # plane stopped/overflowed mid-call: go direct
+    return verify_batch_direct(pubs, msgs, sigs, kernels, breaker)
+
+
+def verify_batch_direct(
+    pubs: Sequence[PubKey],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    kernels: dict = None,
+    breaker: CircuitBreaker = None,
+) -> np.ndarray:
+    """The direct (non-plane) batch verify: group rows by key type and
+    dispatch each group to its kernel under the circuit breaker.
+
     kernels overrides the per-type kernel (e.g. the Pallas ed25519 path).
     breaker overrides the global device circuit breaker (tests)."""
     n = len(pubs)

@@ -201,6 +201,28 @@ class NodeMetrics:
             "Device batch verification wall time",
             buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1),
         )
+        self.breaker_open = r.gauge(
+            "crypto", "breaker_open",
+            "1 while the device circuit breaker is OPEN "
+            "(batches on the host fallback path)")
+        # verify plane (continuous-batching scheduler)
+        self.plane_queue_depth = r.gauge(
+            "verifyplane", "queue_depth",
+            "Signature rows pending in the verify plane")
+        self.plane_batch_size = r.histogram(
+            "verifyplane", "batch_size",
+            "Rows per dispatched verify-plane flush",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+        )
+        self.plane_wait_seconds = r.histogram(
+            "verifyplane", "submit_to_result_seconds",
+            "Verify-plane submit-to-result latency",
+            buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025,
+                     0.05, 0.1, 0.5),
+        )
+        self.plane_padding_waste = r.counter(
+            "verifyplane", "padding_waste_total",
+            "Dead rows added padding flushes to compiled bucket shapes")
         # mempool
         self.mempool_size = r.gauge("mempool", "size",
                                     "Pending transactions")
@@ -211,4 +233,16 @@ class NodeMetrics:
                                          "1 while block-syncing")
 
     def expose_text(self) -> str:
+        # scrape-time refresh: the breaker trips inside
+        # crypto.batch.verify_batch_direct with no metrics handle, so
+        # the gauge is sampled here instead of pushed on state change —
+        # /metrics is always current even with the plane idle/disabled
+        try:
+            from cometbft_tpu.crypto import batch as cbatch
+
+            self.breaker_open.set(
+                1.0 if cbatch.device_breaker().state == "open" else 0.0
+            )
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
         return self.registry.expose_text()

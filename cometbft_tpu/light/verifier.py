@@ -30,6 +30,17 @@ from cometbft_tpu.types.validator import ValidatorSet
 DEFAULT_TRUST_LEVEL = (1, 3)
 
 
+def _resolve_batch_fn(batch_fn: Optional[Callable]) -> Optional[Callable]:
+    """An explicit batch_fn wins; otherwise commits route through the
+    running verify plane (cross-caller coalescing) when there is one,
+    and fall back to the serial host loop when there isn't."""
+    if batch_fn is not None:
+        return batch_fn
+    from cometbft_tpu.verifyplane import plane_batch_fn
+
+    return plane_batch_fn()
+
+
 class LightClientError(Exception):
     pass
 
@@ -133,6 +144,7 @@ def verify_non_adjacent(
     batch_fn: Optional[Callable] = None,
 ) -> None:
     """light/verifier.go:32 VerifyNonAdjacent."""
+    batch_fn = _resolve_batch_fn(batch_fn)
     if untrusted.height == trusted.height + 1:
         raise LightClientError("headers are adjacent: use verify_adjacent")
     if header_expired(trusted.header, trusting_period, now):
@@ -178,6 +190,7 @@ def verify_adjacent(
 ) -> None:
     """light/verifier.go:93 VerifyAdjacent: height+1, linked by
     next_validators_hash (:117)."""
+    batch_fn = _resolve_batch_fn(batch_fn)
     if untrusted.height != trusted.height + 1:
         raise LightClientError("headers must be adjacent in height")
     if header_expired(trusted.header, trusting_period, now):

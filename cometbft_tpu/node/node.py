@@ -40,6 +40,7 @@ class Node(BaseService):
         statesync_light_client=None,
         statesync_discovery: float = 45.0,
         app_state_bytes: bytes = b"",
+        verify_plane=None,
     ):
         """statesync_light_client: a light.Client already trusting a root
         header; providing it turns on the statesync->blocksync->consensus
@@ -156,6 +157,19 @@ class Node(BaseService):
 
         self.metrics = NodeMetrics()
         self.event_bus = EventBus()
+        # verify plane (config [verify_plane]; cometbft_tpu.verifyplane):
+        # accepts a VerifyPlaneConfig, a ready VerifyPlane, or None.
+        # Started with the node; registered as THE global plane so every
+        # verification consumer in-process coalesces through it.
+        self.verify_plane = None
+        if verify_plane is not None:
+            if hasattr(verify_plane, "build"):
+                self.verify_plane = verify_plane.build(
+                    metrics=self.metrics)
+            else:
+                self.verify_plane = verify_plane
+                if self.verify_plane.metrics is None:
+                    self.verify_plane.metrics = self.metrics
         # indexers + pruner (node/node.go:311-316 createAndStartIndexer,
         # state/pruner.go)
         from cometbft_tpu.state.indexer import (
@@ -296,6 +310,11 @@ class Node(BaseService):
         self.switch.dial_peer(addr, persistent=persistent)
 
     def on_start(self) -> None:
+        if self.verify_plane is not None:
+            from cometbft_tpu import verifyplane
+
+            self.verify_plane.start()
+            verifyplane.set_global_plane(self.verify_plane)
         self.pruner.start()
         if self.switch is not None:
             self.switch.start()
@@ -360,6 +379,13 @@ class Node(BaseService):
         self.consensus.start()
 
     def on_stop(self) -> None:
+        if self.verify_plane is not None:
+            from cometbft_tpu import verifyplane
+
+            # unregister first: in-flight verifiers fall back to their
+            # direct paths instead of racing the drain
+            verifyplane.clear_global_plane(self.verify_plane)
+            self.verify_plane.stop()
         if getattr(self, "rpc_server", None) is not None:
             self.rpc_server.stop()
         self.indexer_service.stop()

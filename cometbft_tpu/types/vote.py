@@ -68,6 +68,26 @@ class Vote:
         ):
             raise VoteError("invalid vote extension signature")
 
+    def verify_with_extension(self, chain_id: str, pub_key) -> None:
+        """Verify the vote AND its extension signature in one pass
+        (types/vote.go VerifyVoteAndExtension): both sign-bytes are
+        staged, then both signatures checked in a single loop — the
+        host-path counterpart of submitting both to the verify plane
+        as one batch. Raises VoteError naming the failing signature."""
+        if pub_key.address() != self.validator_address:
+            raise VoteError("invalid validator address")
+        if not self.extension_signature:
+            raise VoteError("missing vote extension signature")
+        checks = (
+            (self.sign_bytes(chain_id), self.signature,
+             "invalid signature"),
+            (self.extension_sign_bytes(chain_id), self.extension_signature,
+             "invalid vote extension signature"),
+        )
+        for msg, sig, err in checks:
+            if not pub_key.verify_signature(msg, sig):
+                raise VoteError(err)
+
     def validate_basic(self) -> None:
         """types/vote.go:284 ValidateBasic."""
         if self.vote_type not in (

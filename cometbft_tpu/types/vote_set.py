@@ -67,6 +67,12 @@ class VoteSet:
         self.maj23: Optional[BlockID] = None
         self.votes_by_block: Dict[bytes, _BlockVotes] = {}
         self.peer_maj23s: Dict[str, BlockID] = {}
+        # verify-plane integration: None = follow the global plane; a
+        # VerifyPlane instance pins one (tests). Per-block quorum groups
+        # carry this set's fused voting-power tally on the plane.
+        self.verify_plane = None
+        self._plane_groups: Dict[bytes, object] = {}
+        self._valset_cols = None  # (pubs tuple, powers tuple), lazy
 
     def size(self) -> int:
         return len(self.valset)
@@ -76,13 +82,25 @@ class VoteSet:
     def add_vote(self, vote: Optional[Vote], verify: bool = True) -> bool:
         """AddVote (vote_set.go:157). Returns True if added. Raises
         ConflictingVoteError on equivocation, VoteSetError/VoteError on
-        invalid votes."""
+        invalid votes.
+
+        With a running verify plane, signature verification leaves the
+        lock: the vote (and its extension signature, as ONE submission)
+        coalesces with other callers into a shared device pass, and the
+        block's power tally is fused into that same pass; admission is
+        re-checked under the lock afterwards."""
         if vote is None:
             raise VoteSetError("nil vote")
+        plane = self._plane() if verify else None
+        if plane is not None:
+            return self._add_vote_plane(vote, plane)
         with self._lock:
             return self._add_vote(vote, verify)
 
-    def _add_vote(self, vote: Vote, verify: bool) -> bool:
+    def _precheck(self, vote: Vote):
+        """Structural checks preceding verification (vote_set.go:
+        157-214). Returns the validator, or None for an exact
+        duplicate. Caller holds the lock."""
         val_index = vote.validator_index
         if val_index < 0:
             raise VoteSetError("index < 0")
@@ -100,37 +118,207 @@ class VoteSet:
             raise VoteSetError(f"no validator at index {val_index}")
         if vote.validator_address != val.address:
             raise VoteSetError("validator address/index mismatch")
-
         existing = self.votes[val_index]
         if existing is not None and existing.block_id == vote.block_id:
-            return False  # duplicate
+            return None  # duplicate
+        return val
 
-        if verify:
-            try:
-                vote.verify(self.chain_id, val.pub_key)
-            except VoteError as e:
-                raise VoteSetError(f"invalid vote: {e}") from e
-
-        # extension discipline (vote_set.go:216-231 w/ extensions):
-        # required+verified on non-nil precommits when enabled; forbidden
-        # in every other case
+    def _ext_discipline(self, vote: Vote):
+        """(need_ext_verify, deferred_error): extension rules
+        (vote_set.go:216-231). The error string is raised only after
+        the vote signature itself verifies, preserving the serial
+        path's error precedence."""
         is_commit_precommit = (
             self.signed_msg_type == 2 and not vote.block_id.is_nil()
         )
         if self.ext_enabled and is_commit_precommit:
             if not vote.extension_signature:
-                raise VoteSetError("vote extension signature is missing")
-            if verify:
+                return False, "vote extension signature is missing"
+            return True, None
+        if vote.extension or vote.extension_signature:
+            return False, "unexpected vote extension"
+        return False, None
+
+    def _add_vote(self, vote: Vote, verify: bool) -> bool:
+        val = self._precheck(vote)
+        if val is None:
+            return False  # duplicate
+
+        need_ext, ext_err = self._ext_discipline(vote)
+        if verify:
+            if need_ext:
+                # one host pass over vote + extension signatures — the
+                # serial-path mirror of the plane's single submission
                 try:
-                    vote.verify_extension(self.chain_id, val.pub_key)
+                    vote.verify_with_extension(self.chain_id, val.pub_key)
                 except VoteError as e:
-                    raise VoteSetError(
-                        f"invalid vote extension: {e}"
-                    ) from e
-        elif vote.extension or vote.extension_signature:
-            raise VoteSetError("unexpected vote extension")
+                    kind = ("invalid vote extension"
+                            if "extension" in str(e) else "invalid vote")
+                    raise VoteSetError(f"{kind}: {e}") from e
+            else:
+                try:
+                    vote.verify(self.chain_id, val.pub_key)
+                except VoteError as e:
+                    raise VoteSetError(f"invalid vote: {e}") from e
+        if ext_err is not None:
+            raise VoteSetError(ext_err)
 
         return self._add_verified(vote, val.voting_power)
+
+    # -- verify-plane path ---------------------------------------------------
+
+    def _plane(self):
+        """The verify plane to use, or None for the serial host path."""
+        p = self.verify_plane
+        if p is not None:
+            return p if p.is_running() and not p.in_dispatcher() else None
+        from cometbft_tpu.verifyplane import global_plane
+
+        return global_plane()
+
+    def _valset_columns(self):
+        if self._valset_cols is None:
+            self._valset_cols = (
+                tuple(v.pub_key.data for v in self.valset.validators),
+                tuple(v.voting_power for v in self.valset.validators),
+            )
+        return self._valset_cols
+
+    def _plane_group(self, block_id: BlockID):
+        """The fused-tally quorum group for one candidate block. Caller
+        holds the lock."""
+        key = block_id.key()
+        g = self._plane_groups.get(key)
+        if g is None:
+            from cometbft_tpu.verifyplane import QuorumGroup
+
+            pubs, powers = self._valset_columns()
+            g = QuorumGroup(
+                self.valset.total_voting_power() * 2 // 3 + 1,
+                name=f"h{self.height}/r{self.round}"
+                     f"/t{self.signed_msg_type}",
+                valset_pubs=pubs, valset_powers=powers,
+            )
+            self._plane_groups[key] = g
+        return g
+
+    def _add_vote_plane(self, vote: Vote, plane) -> bool:
+        from cometbft_tpu.verifyplane import PlaneError
+
+        with self._lock:
+            val = self._precheck(vote)
+            if val is None:
+                return False
+            need_ext, ext_err = self._ext_discipline(vote)
+            group = self._plane_group(vote.block_id)
+            # counted = this vote would add power to its block's tally
+            # if valid and still admissible (existing None, or
+            # peer-maj23-unlocked equivocation with a free slot); a
+            # discipline violation rejects the vote regardless
+            existing = self.votes[vote.validator_index]
+            bv = self.votes_by_block.get(vote.block_id.key())
+            counted = ext_err is None and (
+                existing is None
+                or (bv is not None and bv.peer_maj23
+                    and bv.votes[vote.validator_index] is None)
+            )
+
+        # signature staging + the wait happen OUTSIDE the lock: that is
+        # what lets concurrent gossip callers coalesce into one flush
+        rows = [(val.pub_key, vote.sign_bytes(self.chain_id),
+                 vote.signature)]
+        vidx = [vote.validator_index]
+        if need_ext:
+            rows.append((val.pub_key,
+                         vote.extension_sign_bytes(self.chain_id),
+                         vote.extension_signature))
+            vidx.append(vote.validator_index)
+        try:
+            fut = plane.submit_many(rows, power=val.voting_power,
+                                    group=group, counted=counted,
+                                    vidx=vidx)
+            verdicts = fut.result()
+        except PlaneError:
+            # plane stopped/saturated mid-call: serial host fallback
+            with self._lock:
+                return self._add_vote(vote, True)
+
+        if not verdicts[0]:
+            raise VoteSetError("invalid vote: invalid signature")
+        if ext_err is not None:
+            if counted:  # unreachable (counted excludes ext_err) — guard
+                group.retract(val.voting_power)
+            raise VoteSetError(ext_err)
+        if need_ext and not verdicts[1]:
+            # vote power must not stand once the extension is rejected;
+            # the plane's all-rows gate (or the fused path's post-
+            # correction) already kept it out of the tally
+            raise VoteSetError(
+                "invalid vote extension: invalid vote extension signature"
+            )
+
+        with self._lock:
+            return self._admit_verified(vote, val.voting_power, group,
+                                        counted)
+
+    def _admit_verified(self, vote: Vote, power: int, group,
+                        plane_counted: bool) -> bool:
+        """Post-plane admission: _add_verified minus re-verification,
+        plus reconciliation of the plane's fused tally against what was
+        actually admitted (the state may have moved while the signature
+        was in flight). Caller holds the lock."""
+        val_index = vote.validator_index
+        key = vote.block_id.key()
+        existing = self.votes[val_index]
+        admitted_to_block = False
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                # duplicate raced in while we verified
+                if plane_counted and group is not None:
+                    group.retract(power)
+                return False
+            bv = self.votes_by_block.get(key)
+            if bv is None or not bv.peer_maj23:
+                if plane_counted and group is not None:
+                    group.retract(power)
+                raise ConflictingVoteError(existing, vote)
+            self.votes[val_index] = vote
+        else:
+            self.votes[val_index] = vote
+            self.votes_bit_array.set_index(val_index, True)
+            self.sum += power
+
+        bv = self.votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(
+                peer_maj23=False,
+                bit_array=BitArray(self.size()),
+                votes=[None] * self.size(),
+            )
+            self.votes_by_block[key] = bv
+        elif existing is not None and bv.votes[val_index] is not None:
+            if plane_counted and group is not None:
+                group.retract(power)
+            return False  # already counted in this block's tally
+        bv.votes[val_index] = vote
+        bv.bit_array.set_index(val_index, True)
+        old_sum = bv.sum
+        bv.sum += power
+        admitted_to_block = True
+
+        if group is not None and not plane_counted and admitted_to_block:
+            # the plane didn't tally this one (precheck said it wouldn't
+            # count) but admission did — bring the fused tally back in
+            # sync with bv.sum
+            group.add(power)
+
+        # quorum: the plane's fused tally fires the group event inside
+        # the flush; maj23 itself flips on the exact same crossing
+        # (vote_set.go:307-325), kept bit-identical with the serial path
+        quorum = self.valset.total_voting_power() * 2 // 3 + 1
+        if old_sum < quorum <= bv.sum and self.maj23 is None:
+            self.maj23 = vote.block_id
+        return True
 
     def _add_verified(self, vote: Vote, power: int) -> bool:
         """addVerifiedVote (vote_set.go:257-328)."""

@@ -1,0 +1,33 @@
+"""Verify plane: cross-caller continuous batching for signature verify.
+
+The device is a shared service: every verification consumer (gossiped
+votes, vote extensions, light-client commits, crypto.batch callers)
+submits items to one always-on scheduler that coalesces them into padded
+bucket batches, flushes on a micro-batch deadline or a full bucket, and
+fuses per-group voting-power tallies into the same pass.
+"""
+from cometbft_tpu.verifyplane.plane import (
+    PlaneError,
+    PlaneQueueFull,
+    PlaneStopped,
+    QuorumGroup,
+    VerifyFuture,
+    VerifyPlane,
+    clear_global_plane,
+    global_plane,
+    plane_batch_fn,
+    set_global_plane,
+)
+
+__all__ = [
+    "PlaneError",
+    "PlaneQueueFull",
+    "PlaneStopped",
+    "QuorumGroup",
+    "VerifyFuture",
+    "VerifyPlane",
+    "clear_global_plane",
+    "global_plane",
+    "plane_batch_fn",
+    "set_global_plane",
+]

@@ -1,0 +1,200 @@
+"""Device-fused flush: cached valset table + in-pass quorum tally.
+
+When a flush's submissions all come from quorum groups backed by one
+shared validator set (the gossiped-vote burst shape: many validators'
+precommits for the same height, grouped per candidate block), the plane
+skips the generic grouped dispatch and reuses the cached-valset window
+table (ops.ed25519_cached): each signature is scattered to device row
+``stride*M + validator_index`` so the kernel's static BlockSpec table
+fetch applies, and the per-group voting-power tally is computed by the
+SAME device pass (ed25519_kernel.tally_core) that verifies the
+signatures — the quorum bit a VoteSet waits on is a kernel output, not
+a host reduction.
+
+This is the plane's TPU specialization; it is bypassed on CPU backends
+(the interpret-mode cached kernel costs minutes of compile) where the
+generic host path in plane._verify_rows serves the same semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAX_FUSED_ROWS = 65536
+
+
+class _Plan:
+    """A fully host-side staged fused flush: everything up to (but not
+    including) the device dispatch. Splitting plan from execution lets
+    the plane consume a circuit-breaker probe slot only when a device
+    attempt actually happens (an ineligible flush must not burn the
+    breaker's half-open probe)."""
+
+    __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
+                 "counted_pos", "n_commits", "pubs_v", "powers_v")
+
+
+def _eligible(batch):
+    """All submissions carry validator indices, ed25519 keys only, and
+    share ONE valset-backed group family; returns (valset_pubs,
+    valset_powers) or None."""
+    pubs0 = powers0 = None
+    for sub in batch:
+        g = sub.group
+        if g is None or sub.vidx is None or g.valset_pubs is None:
+            return None
+        if len(sub.vidx) != len(sub.rows):
+            return None
+        # the cached window table is ed25519-only; secp/sr valsets take
+        # the generic grouped dispatch
+        if any(r[0].key_type != "ed25519" or len(r[0].data) != 32
+               for r in sub.rows):
+            return None
+        if pubs0 is None:
+            pubs0, powers0 = g.valset_pubs, g.valset_powers
+        elif g.valset_pubs is not pubs0 and g.valset_pubs != pubs0:
+            return None
+    if pubs0 is None:
+        return None
+    return pubs0, powers0
+
+
+def plan_fused(batch) -> Optional[_Plan]:
+    """Host-side staging of the fused cached-table dispatch for a
+    flush. Returns a _Plan, or None when the flush shape is ineligible
+    — the caller then runs the generic grouped path. No device work
+    happens here (run_fused does that, under the breaker)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    valset = _eligible(batch)
+    if valset is None:
+        return None
+    pubs_v, powers_v = valset
+    nvals = len(pubs_v)
+
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+    from cometbft_tpu.ops.ed25519_pallas import _PB
+
+    M = ec.table_pad(nvals)
+
+    # slot assignment: row -> stride*M + vidx, first free stride wins
+    # (a validator's vote and its extension land in different strides)
+    pubs: List[bytes] = []
+    msgs: List[bytes] = []
+    sigs: List[bytes] = []
+    row_pos: List[int] = []
+    counted_pos: List[Optional[int]] = []  # per submission
+    occupied: List[set] = []
+    groups: List[object] = []
+    gid_of: Dict[int, int] = {}
+    sub_gid: List[int] = []
+    for sub in batch:
+        g = sub.group
+        gid = gid_of.get(id(g))
+        if gid is None:
+            gid = gid_of[id(g)] = len(groups)
+            groups.append(g)
+        sub_gid.append(gid)
+        cpos = None
+        for k, ((pub, msg, sig), v) in enumerate(zip(sub.rows, sub.vidx)):
+            if not (0 <= v < nvals) or pub.data != pubs_v[v] \
+                    or len(sig) != 64:
+                return None  # wrong key/slot claim: generic path decides
+            s = 0
+            while s < len(occupied) and v in occupied[s]:
+                s += 1
+            if s == len(occupied):
+                occupied.append(set())
+            occupied[s].add(v)
+            pos = s * M + v
+            pubs.append(pub.data)
+            msgs.append(msg)
+            sigs.append(sig)
+            row_pos.append(pos)
+            if k == 0 and sub.counted:
+                if sub.power != powers_v[v]:
+                    return None  # tally rides the table's power column
+                cpos = pos
+        counted_pos.append(cpos)
+    n = len(pubs)
+    B = len(occupied) * M
+    if n == 0 or B > MAX_FUSED_ROWS:
+        return None
+
+    n_commits = len(groups)
+    pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
+    pos = np.asarray(row_pos, np.int64)
+    ry = np.zeros((B, pbd.ry.shape[1]), pbd.ry.dtype)
+    ry[pos] = pbd.ry[:n]
+    rsign = np.zeros(B, np.int32)
+    rsign[pos] = np.asarray(pbd.rsign[:n], np.int32)
+    sdig = np.zeros((B, pbd.sdig.shape[1]), pbd.sdig.dtype)
+    sdig[pos] = pbd.sdig[:n]
+    hdig = np.zeros((B, pbd.hdig.shape[1]), pbd.hdig.dtype)
+    hdig[pos] = pbd.hdig[:n]
+    precheck = np.zeros(B, np.bool_)
+    precheck[pos] = np.asarray(pbd.precheck[:n], np.bool_)
+    counted = np.zeros(B, np.bool_)
+    commit_ids = np.zeros(B, np.int32)
+    cur = 0
+    for sub, gid, cpos in zip(batch, sub_gid, counted_pos):
+        for p in row_pos[cur:cur + len(sub.rows)]:
+            commit_ids[p] = gid
+        cur += len(sub.rows)
+        if cpos is not None:
+            counted[cpos] = True
+    thresh = np.zeros((n_commits, ek.TALLY_LIMBS), np.int32)
+    for gid, g in enumerate(groups):
+        thresh[gid] = ek.threshold_limbs(max(g.threshold - 1, 0))[0]
+
+    pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
+    plan = _Plan()
+    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh)
+    plan.pos = pos
+    plan.batch = batch
+    plan.groups = groups
+    plan.sub_gid = sub_gid
+    plan.counted_pos = counted_pos
+    plan.n_commits = n_commits
+    plan.pubs_v = pubs_v
+    plan.powers_v = powers_v
+    return plan
+
+
+def run_fused(plan: _Plan) -> Tuple[List[bool], Dict[object, int]]:
+    """Execute a staged plan on the device: build/fetch the valset
+    window table, run the fused verify+tally kernel, gate the tallies
+    per submission. Raises on device faults (the caller's breaker
+    handles those).
+
+    Returns (per-row verdicts in flush order, {group: verified power
+    tallied by the device this flush})."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    table = ec.table_for_pubs(list(plan.pubs_v), list(plan.powers_v))
+    valid, tally, _quorum = ec.verify_tally_rows_cached(
+        plan.rows, table, plan.n_commits
+    )
+    valid = np.asarray(valid)
+    tallies_raw = ek.tally_to_int(np.asarray(tally))
+
+    verdicts = [bool(v) for v in valid[plan.pos]]
+    tallies: Dict[object, int] = {
+        g: int(tallies_raw[gid]) for gid, g in enumerate(plan.groups)
+    }
+    # submission gating: power counts only when EVERY row of a counted
+    # submission verified (a valid vote with a forged extension is
+    # rejected by the caller, so its power must not stand in the tally)
+    off = 0
+    for sub, gid, cpos in zip(plan.batch, plan.sub_gid,
+                              plan.counted_pos):
+        sl = verdicts[off:off + len(sub.rows)]
+        off += len(sub.rows)
+        if cpos is not None and sl[0] and not all(sl):
+            tallies[plan.groups[gid]] -= sub.power
+    return verdicts, tallies

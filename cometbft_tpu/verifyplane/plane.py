@@ -1,0 +1,542 @@
+"""The verify plane: a continuous-batching scheduler for the device.
+
+Before this subsystem, only bulk callers (blocksync StreamVerifier,
+commit verification) reached the device in batches; each gossiped vote
+and each vote-extension signature still single-verified serially on the
+host — exactly the hot path under consensus load. EdDSA committee-
+consensus measurements (arXiv:2302.00418) put the win in batch
+verification, and FPGA verification engines for permissioned chains
+(arXiv:2112.02229) use the same shape: one shared hardware queue that
+coalesces independent requests into a single device pass.
+
+Architecture (inference-style continuous batching):
+
+  callers ──submit(pub,msg,sig[,power,group])──► pending queue
+                                                    │
+                 dispatcher thread: flush when the oldest submission is
+                 window_ms old OR max_batch rows are pending
+                                                    │
+                                    one padded bucket-shaped pass
+                         (device kernels under the CircuitBreaker, or
+                          the inline host ed25519_ref path when the
+                          breaker is open / no accelerator exists)
+                                                    │
+              per-item verdict futures  +  per-group power tallies
+              (a QuorumGroup's quorum event fires inside the flush —
+               VoteSet learns "2/3 reached" directly from the plane)
+
+Knobs ([verify_plane] config): window_ms bounds added latency,
+max_batch bounds device batch size (bucket padding reuses the compiled
+kernel shapes from ops/), max_queue bounds memory and provides
+backpressure — a full queue blocks submitters (or raises PlaneQueueFull
+for non-blocking callers, who then verify inline on the host).
+
+Failure injection: the `verifyplane.dispatch` failpoint fires at the
+top of every flush; a raised fault must degrade that flush to the
+inline host path — futures always resolve, submitters never hang.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cometbft_tpu.libs import failpoints as fp
+
+_log = logging.getLogger(__name__)
+
+fp.register("verifyplane.dispatch",
+            "top of a verify-plane flush (raise = dispatch fault; the "
+            "flush must degrade to the inline host path, futures must "
+            "still resolve)")
+
+DISPATCH_LOG_MAX = 64       # flush-composition ring kept for tests/ops
+DEFAULT_RESULT_TIMEOUT = 30.0
+
+
+class PlaneError(Exception):
+    """Base for plane-side failures; callers fall back to host verify."""
+
+
+class PlaneQueueFull(PlaneError):
+    """Backpressure: the pending queue is at max_queue."""
+
+
+class PlaneStopped(PlaneError):
+    """Submitted to a plane that is not running."""
+
+
+class VerifyFuture:
+    """Resolves to a tuple of per-item bool verdicts (one submission may
+    carry several signatures, e.g. a vote + its extension)."""
+
+    __slots__ = ("_ev", "_verdicts", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._verdicts: Optional[Tuple[bool, ...]] = None
+        self._err: Optional[BaseException] = None
+
+    def _resolve(self, verdicts: Sequence[bool]) -> None:
+        self._verdicts = tuple(bool(v) for v in verdicts)
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._err = err
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Tuple[bool, ...]:
+        if not self._ev.wait(DEFAULT_RESULT_TIMEOUT
+                             if timeout is None else timeout):
+            raise PlaneError("verify plane result timed out")
+        if self._err is not None:
+            raise PlaneError(str(self._err)) from self._err
+        return self._verdicts
+
+
+class QuorumGroup:
+    """A fused voting-power tally target.
+
+    Counted submissions tagged with a group add their power to the
+    group's tally inside the dispatch pass (all signatures of the
+    submission must verify). The quorum event fires the moment the
+    tally crosses the threshold — the caller (VoteSet) learns quorum
+    from the plane instead of re-tallying verdicts itself."""
+
+    def __init__(self, threshold: int, name: str = "",
+                 valset_pubs: Optional[tuple] = None,
+                 valset_powers: Optional[tuple] = None):
+        self.threshold = int(threshold)
+        self.name = name
+        # optional valset backing (pubkey bytes + powers, index-aligned):
+        # lets the device flush reuse the cached window table and fuse
+        # this group's tally into the verify kernel (fused.try_fused)
+        self.valset_pubs = valset_pubs
+        self.valset_powers = valset_powers
+        self._lock = threading.Lock()
+        self._tally = 0
+        self._quorum = threading.Event()
+
+    @property
+    def tally(self) -> int:
+        with self._lock:
+            return self._tally
+
+    @property
+    def quorum_reached(self) -> bool:
+        return self._quorum.is_set()
+
+    def wait_quorum(self, timeout: Optional[float] = None) -> bool:
+        return self._quorum.wait(timeout)
+
+    def add(self, power: int) -> bool:
+        """Add verified power; returns True when this add crossed the
+        threshold."""
+        with self._lock:
+            old = self._tally
+            self._tally += int(power)
+            crossed = old < self.threshold <= self._tally
+        if crossed:
+            self._quorum.set()
+        return crossed
+
+    def retract(self, power: int) -> None:
+        """Undo a tallied contribution (the caller's admission step
+        found the vote inadmissible after all — duplicate race or
+        equivocation). A retraction that drops the tally back below
+        the threshold also clears the quorum event: the crossing was
+        a transient double-count, not a real 2/3 (maj23 itself only
+        flips on a genuine bv.sum crossing, so consensus never acted
+        on the phantom signal)."""
+        with self._lock:
+            self._tally -= int(power)
+            if self._tally < self.threshold:
+                self._quorum.clear()
+
+
+class _Submission:
+    __slots__ = ("rows", "future", "group", "power", "counted",
+                 "vidx", "t_submit", "tid")
+
+    def __init__(self, rows, group, power, counted, vidx=None):
+        self.rows = rows                      # [(PubKey, msg, sig), ...]
+        self.future = VerifyFuture()
+        self.group = group
+        self.power = int(power)
+        self.counted = bool(counted)
+        self.vidx = tuple(vidx) if vidx is not None else None
+        self.t_submit = time.perf_counter()
+        self.tid = threading.get_ident()
+
+
+def _host_verdicts(rows) -> List[bool]:
+    """Inline host path: per-row single verify via the reference-path
+    PubKey.verify_signature (ed25519_ref and friends)."""
+    out = []
+    for pub, msg, sig in rows:
+        try:
+            out.append(bool(pub.verify_signature(msg, sig)))
+        except ValueError:
+            out.append(False)
+    return out
+
+
+class VerifyPlane:
+    """Always-on background scheduler turning the device into a shared
+    verification service. Start/stop with the node lifecycle."""
+
+    def __init__(self, window_ms: float = 1.5, max_batch: int = 1024,
+                 max_queue: int = 8192, metrics=None,
+                 kernels: Optional[dict] = None, breaker=None,
+                 use_device: Optional[bool] = None):
+        from cometbft_tpu.crypto import batch as cbatch
+
+        self.window = max(0.0, window_ms) / 1000.0
+        self.max_batch = max(1, int(max_batch))
+        self.max_queue = max(1, int(max_queue))
+        self.metrics = metrics
+        self._kernels = kernels
+        self._breaker = breaker if breaker is not None \
+            else cbatch.device_breaker()
+        # device dispatch only when a kernel set was injected (tests) or
+        # an accelerator actually exists — the XLA/interpret kernels on
+        # CPU cost minutes of compile, so the CPU plane coalesces and
+        # verifies on the inline host path instead
+        self._use_device = (use_device if use_device is not None
+                            else kernels is not None
+                            or cbatch._accel_backend())
+        self._cv = threading.Condition()
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        # observability (also mirrored into NodeMetrics when attached)
+        self.dispatch_log: deque = deque(maxlen=DISPATCH_LOG_MAX)
+        self.batches = 0
+        self.rows_verified = 0
+        self.padding_waste = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name="verify-plane", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # resolve anything the dispatcher didn't drain so no submitter
+        # ever hangs on a stopped plane
+        leftovers = []
+        with self._cv:
+            while self._pending:
+                leftovers.append(self._pending.popleft())
+            self._pending_rows = 0
+        for sub in leftovers:
+            sub.future._fail(PlaneStopped("verify plane stopped"))
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def in_dispatcher(self) -> bool:
+        """True on the dispatcher thread (recursion guard: the
+        dispatcher's own verify calls must not re-enter the plane)."""
+        return threading.current_thread() is self._thread
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, pub, msg: bytes, sig: bytes, power: int = 0,
+               group: Optional[QuorumGroup] = None, counted: bool = False,
+               vidx: Optional[int] = None,
+               block: bool = True) -> VerifyFuture:
+        """Submit one (pubkey, msg, sig); the future resolves to a
+        1-tuple verdict."""
+        return self.submit_many(
+            [(pub, msg, sig)], power=power, group=group, counted=counted,
+            vidx=None if vidx is None else (vidx,), block=block,
+        )
+
+    def submit_many(self, rows, power: int = 0,
+                    group: Optional[QuorumGroup] = None,
+                    counted: bool = False,
+                    vidx: Optional[Sequence[int]] = None,
+                    block: bool = True) -> VerifyFuture:
+        """Submit several signatures as ONE unit (e.g. a vote and its
+        extension): one future, per-row verdicts, and — when counted —
+        the group tally credits `power` only if EVERY row verifies.
+        vidx (one validator index per row) enables the fused cached-
+        table device path for valset-backed groups; row 0 must be the
+        power-bearing signature (the vote; extensions follow)."""
+        rows = list(rows)
+        if not rows:
+            raise ValueError("empty submission")
+        if not self._running or self.in_dispatcher():
+            raise PlaneStopped("verify plane not accepting submissions")
+        sub = _Submission(rows, group, power, counted, vidx)
+        deadline = time.monotonic() + DEFAULT_RESULT_TIMEOUT
+        with self._cv:
+            # backpressure gates on what is already queued — a lone
+            # submission larger than max_queue still enters an empty
+            # queue (it dispatches alone) instead of deadlocking
+            while self._running and self._pending_rows and \
+                    self._pending_rows + len(rows) > self.max_queue:
+                if not block:
+                    raise PlaneQueueFull(
+                        f"verify plane queue full ({self.max_queue} rows)"
+                    )
+                if not self._cv.wait(timeout=deadline - time.monotonic()) \
+                        and time.monotonic() >= deadline:
+                    raise PlaneQueueFull(
+                        "verify plane backpressure wait timed out"
+                    )
+            if not self._running:
+                raise PlaneStopped("verify plane stopped")
+            self._pending.append(sub)
+            self._pending_rows += len(rows)
+            if self.metrics is not None:
+                self.metrics.plane_queue_depth.set(self._pending_rows)
+            self._cv.notify_all()
+        return sub.future
+
+    def submit_and_wait(self, pubs, msgs, sigs,
+                        timeout: Optional[float] = None) -> np.ndarray:
+        """crypto.batch.verify_batch shape: (n,) bool validity through
+        the plane (one submission, one flush slot)."""
+        fut = self.submit_many(list(zip(pubs, msgs, sigs)))
+        if timeout is None:
+            # scale with batch size: a 10k-row host-path flush on a
+            # 1-core box legitimately outlives the default window
+            timeout = max(DEFAULT_RESULT_TIMEOUT, 0.05 * len(pubs))
+        return np.asarray(fut.result(timeout), np.bool_)
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch: List[_Submission] = []
+            with self._cv:
+                while self._running:
+                    if self._pending:
+                        age = time.perf_counter() - \
+                            self._pending[0].t_submit
+                        if (age >= self.window
+                                or self._pending_rows >= self.max_batch):
+                            break
+                        self._cv.wait(timeout=self.window - age)
+                    else:
+                        self._cv.wait(timeout=0.25)
+                if not self._running and not self._pending:
+                    return
+                # drain whole submissions up to max_batch rows (a lone
+                # oversized submission still dispatches alone)
+                rows = 0
+                while self._pending:
+                    nxt = len(self._pending[0].rows)
+                    if batch and rows + nxt > self.max_batch:
+                        break
+                    sub = self._pending.popleft()
+                    rows += nxt
+                    batch.append(sub)
+                self._pending_rows -= rows
+                if self.metrics is not None:
+                    self.metrics.plane_queue_depth.set(self._pending_rows)
+                self._cv.notify_all()  # wake backpressured submitters
+            if batch:
+                self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Submission]) -> None:
+        rows = [r for sub in batch for r in sub.rows]
+        fused = None
+        try:
+            fp.fail_point("verifyplane.dispatch")
+            fused = self._try_fused(batch)
+            verdicts = fused[0] if fused is not None \
+                else self._verify_rows(rows)
+        except Exception:  # noqa: BLE001 - dispatch fault, not verdicts
+            _log.exception(
+                "verify plane dispatch fault (%d rows); degrading this "
+                "flush to the inline host path", len(rows),
+            )
+            fused = None
+            verdicts = _host_verdicts(rows)
+        self._settle(batch, verdicts,
+                     fused_tallies=fused[1] if fused else None)
+
+    def _try_fused(self, batch):
+        """The cached-valset fused verify+tally device pass, when the
+        flush shape allows it (see fused.plan_fused). The breaker's
+        allow() — which consumes the single half-open probe slot when
+        the breaker is open — is only asked once a plan exists, i.e.
+        when a device attempt will actually happen; an ineligible flush
+        must not burn the probe the generic path needs to recover."""
+        if not self._use_device or self._kernels is not None:
+            return None
+        from cometbft_tpu.verifyplane import fused as fz
+
+        try:
+            plan = fz.plan_fused(batch)
+        except Exception:  # noqa: BLE001 - host staging bug, not device
+            _log.exception("fused flush staging failed; grouped path")
+            return None
+        if plan is None or not self._breaker.allow():
+            return None
+        try:
+            out = fz.run_fused(plan)
+        except Exception:  # noqa: BLE001 - device fault
+            self._breaker.record_failure()
+            _log.exception(
+                "fused verify-plane dispatch failed; falling back to "
+                "the grouped path"
+            )
+            return None
+        self._breaker.record_success()
+        return out
+
+    def _verify_rows(self, rows) -> List[bool]:
+        """One padded device pass under the circuit breaker, or the
+        inline host path when no accelerator exists. verify_batch_direct
+        itself degrades to the host path when the breaker is open or the
+        device faults mid-flush."""
+        if not self._use_device:
+            return _host_verdicts(rows)
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.ops import ed25519_kernel as ek
+
+        n = len(rows)
+        try:
+            waste = ek.bucket_size(n) - n
+        except ValueError:
+            waste = 0
+        self.padding_waste += waste
+        if self.metrics is not None:
+            self.metrics.plane_padding_waste.inc(waste)
+        pubs = [r[0] for r in rows]
+        msgs = [r[1] for r in rows]
+        sigs = [r[2] for r in rows]
+        valid = cbatch.verify_batch_direct(
+            pubs, msgs, sigs, kernels=self._kernels, breaker=self._breaker
+        )
+        return [bool(v) for v in np.asarray(valid)[:n]]
+
+    def _settle(self, batch: List[_Submission], verdicts,
+                fused_tallies=None) -> None:
+        """Scatter verdicts to futures + fuse the per-group tallies —
+        one pass over the flush, so a VoteSet's quorum event fires
+        before any submitter even wakes. With fused_tallies (the device
+        pass computed the per-group sums) the host adds those instead
+        of re-reducing verdicts."""
+        now = time.perf_counter()
+        if fused_tallies is not None:
+            for g, t in fused_tallies.items():
+                if t:
+                    g.add(t)
+        off = 0
+        tids = set()
+        for sub in batch:
+            sl = verdicts[off:off + len(sub.rows)]
+            off += len(sub.rows)
+            tids.add(sub.tid)
+            if fused_tallies is None and sub.counted \
+                    and sub.group is not None and all(sl):
+                sub.group.add(sub.power)
+            if self.metrics is not None:
+                self.metrics.plane_wait_seconds.observe(now - sub.t_submit)
+            sub.future._resolve(sl)
+        self.batches += 1
+        self.rows_verified += off
+        if self.metrics is not None:
+            self.metrics.plane_batch_size.observe(off)
+            # breaker_open is sampled at scrape time by
+            # NodeMetrics.expose_text (it must stay fresh with the
+            # plane idle too), so no push here
+        self.dispatch_log.append({
+            "rows": off,
+            "submissions": len(batch),
+            "tids": tids,
+        })
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cv:
+            depth = self._pending_rows
+        return {
+            "running": self._running,
+            "queue_depth": depth,
+            "batches": self.batches,
+            "rows_verified": self.rows_verified,
+            "padding_waste": self.padding_waste,
+            "breaker_state": self._breaker.state,
+            "use_device": self._use_device,
+        }
+
+
+# --------------------------------------------------------------------------
+# the process-global plane (node lifecycle owns it)
+# --------------------------------------------------------------------------
+
+_GLOBAL: Optional[VerifyPlane] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def set_global_plane(plane: Optional[VerifyPlane]) -> None:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = plane
+
+
+def clear_global_plane(plane: VerifyPlane) -> None:
+    """Unregister `plane` if (and only if) it is the current global —
+    a stopping node must not tear down another node's plane."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is plane:
+            _GLOBAL = None
+
+
+def global_plane() -> Optional[VerifyPlane]:
+    """The running global plane, or None. Returns None on the plane's
+    own dispatcher thread (callers there must verify directly)."""
+    p = _GLOBAL
+    if p is None or not p.is_running() or p.in_dispatcher():
+        return None
+    return p
+
+
+def plane_batch_fn() -> Optional[Callable]:
+    """A batch_fn(pubs, msgs, sigs) -> (n,) bool routed through the
+    running global plane, or None when no plane is running — callers
+    keep their existing direct path in that case."""
+    if global_plane() is None:
+        return None
+
+    def fn(pubs, msgs, sigs):
+        p = global_plane()
+        if p is not None:
+            try:
+                return p.submit_and_wait(pubs, msgs, sigs)
+            except PlaneError:
+                pass  # stopped/overflowed mid-call: verify directly
+        from cometbft_tpu.crypto import batch as cbatch
+
+        return cbatch.verify_batch_direct(pubs, msgs, sigs)
+
+    return fn

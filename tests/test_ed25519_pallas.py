@@ -24,6 +24,8 @@ def make_sigs(n, msg_fn=lambda i: b"msg-%d" % i):
     return pubs, msgs, sigs
 
 
+@pytest.mark.slow  # ~75 s interpret-mode run on the 1-core host;
+# zip215_edges/blame_path keep the quick-gate Pallas coverage
 def test_all_valid_batch():
     pubs, msgs, sigs = make_sigs(5)
     got = kp.verify_batch(pubs, msgs, sigs)
@@ -85,6 +87,7 @@ def test_zip215_edges():
     assert any(exp)
 
 
+@pytest.mark.slow  # ~150 s interpret-mode cross-tile sweep
 def test_matches_xla_kernel_cross_tile():
     """Pallas and XLA kernels agree on a batch spanning >1 tile (B=256)."""
     pubs, msgs, sigs = make_sigs(140)
@@ -106,6 +109,7 @@ def test_pad_to_tile():
     assert kp.pad_to_tile(257) == 1024
 
 
+@pytest.mark.slow  # ~90 s interpret-mode multi-tile tally
 def test_tally_multi_tile_with_invalid_and_quorum_miss():
     """verify_tally_rows across a >2-tile grid: invalid rows excluded
     from the tally, quorum-miss detected (round-2 verdict item 5 at a

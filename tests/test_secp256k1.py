@@ -156,6 +156,8 @@ def test_address():
     ).digest()
 
 
+@pytest.mark.slow  # ~75 s: compiles two kernels for one commit;
+# ecdsa_batch_valid_and_blame keeps the quick-gate batch coverage
 def test_mixed_key_commit_verification():
     """A commit signed by a mix of ed25519 and secp256k1 validators
     verifies in one batch call — capability the reference lacks entirely
@@ -216,6 +218,8 @@ def test_mixed_key_commit_verification():
     assert ei.value.idx == secp_idx
 
 
+@pytest.mark.slow  # >8 min interpret-mode ECDSA Pallas on CPU —
+# the single biggest tier-1 budget sink before it was marked
 def test_ecdsa_pallas_matches_oracle():
     """Pallas ECDSA kernel vs the pure-Python oracle (interpret mode on
     CPU; Mosaic on TPU) — one tile incl. malformed/corrupt rows."""

@@ -117,6 +117,8 @@ def _fixture(n, bad=()):
     return pubs, msgs, sigs
 
 
+@pytest.mark.slow  # ~6 min sr25519 kernel compile+run on CPU;
+# kernel_rejects_bad_encodings keeps a quick-gate kernel probe
 def test_kernel_matches_oracle():
     from cometbft_tpu.ops import sr25519_kernel as srk
 

@@ -1,0 +1,410 @@
+"""Verify plane (cometbft_tpu.verifyplane): cross-caller continuous
+batching on CPU — coalescing across submitter threads, per-future
+verdict correctness against the ed25519_ref oracle, deadline flush,
+breaker-open host fallback, queue-overflow backpressure, the
+`verifyplane.dispatch` failpoint, and VoteSet quorum through the fused
+tally path (ISSUE 2 acceptance criteria). All host-path and fast: the
+CPU plane never touches the minutes-to-compile kernels."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import ed25519_ref as ed
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.verifyplane import (
+    PlaneError,
+    PlaneQueueFull,
+    QuorumGroup,
+    VerifyPlane,
+    global_plane,
+    plane_batch_fn,
+    set_global_plane,
+)
+
+WINDOW_MS = 25.0
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    fp.reset()
+    set_global_plane(None)
+    cbatch.device_breaker().reset()
+    yield
+    fp.reset()
+    set_global_plane(None)
+    cbatch.device_breaker().reset()
+
+
+@pytest.fixture()
+def plane():
+    p = VerifyPlane(window_ms=WINDOW_MS, max_batch=256, max_queue=1024)
+    p.start()
+    yield p
+    p.stop()
+
+
+def make_rows(n=12, seed=40):
+    """n ed25519 rows, every 4th signature corrupted; oracle verdicts."""
+    privs = [PrivKey.generate(bytes([seed + i]) * 32) for i in range(n)]
+    pubs = [p.pub_key() for p in privs]
+    msgs = [b"plane-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    for i in range(0, n, 4):
+        sigs[i] = b"\x5a" * 64
+    exp = [ed.verify(p.data, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    assert True in exp and False in exp
+    return pubs, msgs, sigs, exp
+
+
+# -- coalescing + correctness ----------------------------------------------
+
+
+def test_multithread_coalescing_correctness(plane):
+    """Items from >= 2 distinct submitter threads land in ONE dispatched
+    batch, and every future resolves to the oracle verdict even with
+    valid/invalid rows interleaved."""
+    pubs, msgs, sigs, exp = make_rows(12)
+    results = {}
+    start = threading.Barrier(3)
+
+    def worker(lo, hi):
+        start.wait()
+        futs = [(i, plane.submit(pubs[i], msgs[i], sigs[i]))
+                for i in range(lo, hi)]
+        for i, f in futs:
+            results[i] = f.result(10.0)[0]
+
+    threads = [threading.Thread(target=worker, args=(k * 4, k * 4 + 4))
+               for k in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert [results[i] for i in range(12)] == exp
+    # the barrier releases all three submitters inside one window, so at
+    # least one flush must have coalesced across threads
+    assert any(len(d["tids"]) >= 2 for d in plane.dispatch_log), \
+        list(plane.dispatch_log)
+
+
+def test_deadline_flush_lone_item(plane):
+    """A lone submission with no other traffic flushes on the window
+    deadline, not never."""
+    pubs, msgs, sigs, exp = make_rows(2)
+    t0 = time.perf_counter()
+    fut = plane.submit(pubs[1], msgs[1], sigs[1])
+    got = fut.result(5.0)
+    elapsed = time.perf_counter() - t0
+    assert got == (exp[1],)
+    assert elapsed < 5.0
+    assert any(d["rows"] == 1 for d in plane.dispatch_log)
+
+
+def test_submit_and_wait_batch(plane):
+    pubs, msgs, sigs, exp = make_rows(9)
+    got = plane.submit_and_wait(pubs, msgs, sigs)
+    np.testing.assert_array_equal(got, np.asarray(exp))
+
+
+# -- breaker interaction ---------------------------------------------------
+
+
+def oracle_kernel(pub_bytes, msgs, sigs):
+    return np.asarray(
+        [ed.verify(p, m, s) for p, m, s in zip(pub_bytes, msgs, sigs)]
+    )
+
+
+def test_breaker_open_falls_back_to_host():
+    """A device-mode plane whose kernel faults trips the shared breaker;
+    verdicts stay oracle-correct throughout, and an OPEN breaker stops
+    device dispatch entirely (the armed failpoint would raise)."""
+    brk = cbatch.CircuitBreaker(failure_threshold=1, cooldown=30.0)
+    p = VerifyPlane(window_ms=5.0, kernels={"ed25519": oracle_kernel},
+                    breaker=brk)
+    p.start()
+    try:
+        pubs, msgs, sigs, exp = make_rows(8)
+        fp.arm("crypto.device_dispatch", "raise")
+        got = p.submit_and_wait(pubs, msgs, sigs)
+        np.testing.assert_array_equal(got, np.asarray(exp))
+        assert brk.state == "open"
+        fires = fp.registry().stats("crypto.device_dispatch")["fires"]
+        got = p.submit_and_wait(pubs, msgs, sigs)
+        np.testing.assert_array_equal(got, np.asarray(exp))
+        # no new device dispatch while open: host path served the flush
+        assert fp.registry().stats("crypto.device_dispatch")["fires"] == \
+            fires
+        assert p.stats()["breaker_state"] == "open"
+    finally:
+        p.stop()
+
+
+# -- failpoint + backpressure ----------------------------------------------
+
+
+def test_dispatch_failpoint_degrades_to_host(plane):
+    """An armed verifyplane.dispatch fault degrades the flush to the
+    inline host path: futures still resolve with correct verdicts."""
+    pubs, msgs, sigs, exp = make_rows(6)
+    fp.arm("verifyplane.dispatch", "raise")
+    got = plane.submit_and_wait(pubs, msgs, sigs)
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert fp.registry().stats("verifyplane.dispatch")["fires"] >= 1
+
+
+def test_queue_overflow_backpressure():
+    """max_queue rows pending -> non-blocking submits raise
+    PlaneQueueFull; once the dispatcher drains, everything resolves."""
+    p = VerifyPlane(window_ms=1.0, max_batch=1000, max_queue=8)
+    p.start()
+    try:
+        pubs, msgs, sigs, exp = make_rows(10)
+        # stall the dispatcher inside a flush so the queue can fill
+        fp.arm("verifyplane.dispatch", "delay", arg=1.0, count=1)
+        first = p.submit(pubs[9], msgs[9], sigs[9])
+        time.sleep(0.2)  # dispatcher is now sleeping in the failpoint
+        futs = [p.submit(pubs[i], msgs[i], sigs[i], block=False)
+                for i in range(8)]
+        with pytest.raises(PlaneQueueFull):
+            p.submit(pubs[8], msgs[8], sigs[8], block=False)
+        # blocking submit rides out the backpressure instead of raising
+        blocked = p.submit(pubs[8], msgs[8], sigs[8], block=True)
+        assert blocked.result(10.0) == (exp[8],)
+        assert first.result(10.0) == (exp[9],)
+        for i, f in enumerate(futs):
+            assert f.result(10.0) == (exp[i],)
+    finally:
+        p.stop()
+
+
+def test_stop_drains_pending_futures():
+    """stop() drains queued submissions (graceful) — a submitter never
+    hangs on a stopping plane, and post-stop submits are refused."""
+    p = VerifyPlane(window_ms=10_000.0)  # deadline far away: items queue
+    p.start()
+    pubs, msgs, sigs, exp = make_rows(2)
+    fut = p.submit(pubs[1], msgs[1], sigs[1])
+    p.stop()
+    assert fut.result(1.0) == (exp[1],)
+    with pytest.raises(PlaneError):
+        p.submit(pubs[0], msgs[0], sigs[0])
+
+
+# -- fused quorum tally ----------------------------------------------------
+
+
+def test_quorum_group_fused_tally(plane):
+    """Counted submissions credit the group inside the flush; an
+    invalid row keeps its submission's power out of the tally."""
+    pubs, msgs, sigs, exp = make_rows(8)
+    g = QuorumGroup(threshold=41)
+    futs = [plane.submit(pubs[i], msgs[i], sigs[i], power=10, group=g,
+                         counted=True) for i in range(8)]
+    for f in futs:
+        f.result(10.0)
+    assert g.tally == 10 * sum(exp)
+    assert g.quorum_reached == (g.tally >= 41)
+
+
+def test_quorum_retract_clears_transient_crossing():
+    """A retraction (admission found the vote inadmissible) that drops
+    the tally back below threshold clears the quorum event — a
+    transient double-count must not leave a phantom 2/3 signal."""
+    g = QuorumGroup(threshold=21)
+    g.add(10)
+    g.add(10)
+    assert not g.quorum_reached
+    g.add(10)  # duplicate raced in: 30 >= 21, event fires
+    assert g.quorum_reached
+    g.retract(10)  # admission rejects the duplicate: 20 < 21
+    assert not g.quorum_reached and g.tally == 20
+    g.add(10)  # a genuine third vote re-crosses
+    assert g.quorum_reached
+
+
+def test_voteset_reaches_quorum_through_plane(plane):
+    """Gossiped precommits (vote + extension signatures as ONE
+    submission each) coalesce through the plane; the VoteSet's 2/3
+    quorum comes out of the fused group tally, and a forged extension
+    is rejected without its power standing."""
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet, VoteSetError
+
+    chain = "plane-chain"
+    privs = [PrivKey.generate(bytes([i + 61]) * 32) for i in range(4)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    bid = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+
+    def mk(i):
+        priv = privs[i]
+        idx, _ = vs.get_by_address(priv.pub_key().address())
+        v = Vote(vote_type=canonical.PRECOMMIT_TYPE, height=5, round=0,
+                 block_id=bid, timestamp=Timestamp(1_700_000_000, 0),
+                 validator_address=priv.pub_key().address(),
+                 validator_index=idx, extension=b"ext")
+        v.signature = priv.sign(v.sign_bytes(chain))
+        v.extension_signature = priv.sign(v.extension_sign_bytes(chain))
+        return v
+
+    set_global_plane(plane)
+    vset = VoteSet(chain, 5, 0, canonical.PRECOMMIT_TYPE, vs,
+                   ext_enabled=True)
+    errs = []
+    start = threading.Barrier(3)
+
+    def add(i):
+        start.wait()
+        try:
+            vset.add_vote(mk(i))
+        except Exception as e:  # noqa: BLE001 - assert below
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=add, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    group = vset._plane_groups[bid.key()]
+    assert group.quorum_reached and group.tally == 30
+    assert vset.two_thirds_majority() == bid
+    # vote + extension rode as one 2-row submission
+    assert any(d["rows"] == 2 * d["submissions"]
+               for d in plane.dispatch_log), list(plane.dispatch_log)
+    # forged extension: rejected, no power credited
+    bad = mk(3)
+    bad.extension_signature = b"\x01" * 64
+    with pytest.raises(VoteSetError, match="extension"):
+        vset.add_vote(bad)
+    assert group.tally == 30
+    # duplicate still returns False (no plane round trip needed)
+    assert vset.add_vote(mk(0)) is False
+    assert vset.sum == 30
+
+
+def test_voteset_serial_path_single_pass_when_plane_off():
+    """Plane off: vote + extension verify in ONE host pass
+    (verify_with_extension), semantics unchanged."""
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet, VoteSetError
+
+    chain = "serial-chain"
+    priv = PrivKey.generate(bytes([77]) * 32)
+    vs = ValidatorSet([Validator(priv.pub_key(), 10)])
+    bid = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+    v = Vote(vote_type=canonical.PRECOMMIT_TYPE, height=3, round=0,
+             block_id=bid, timestamp=Timestamp(1_700_000_000, 0),
+             validator_address=priv.pub_key().address(),
+             validator_index=0, extension=b"e")
+    v.signature = priv.sign(v.sign_bytes(chain))
+    v.extension_signature = priv.sign(v.extension_sign_bytes(chain))
+    vset = VoteSet(chain, 3, 0, canonical.PRECOMMIT_TYPE, vs,
+                   ext_enabled=True)
+    assert global_plane() is None
+    assert vset.add_vote(v)
+    assert vset.two_thirds_majority() == bid
+    # bad vote signature reported as the vote, not the extension
+    v2 = Vote(vote_type=canonical.PRECOMMIT_TYPE, height=3, round=0,
+              block_id=BlockID(b"\xee" * 32,
+                               PartSetHeader(1, b"\xff" * 32)),
+              timestamp=Timestamp(1_700_000_000, 0),
+              validator_address=priv.pub_key().address(),
+              validator_index=0, extension=b"e",
+              signature=b"\x02" * 64,
+              extension_signature=b"\x02" * 64)
+    vset2 = VoteSet(chain, 3, 0, canonical.PRECOMMIT_TYPE, vs,
+                    ext_enabled=True)
+    with pytest.raises(VoteSetError, match="invalid vote:"):
+        vset2.add_vote(v2)
+
+
+# -- wiring: crypto.batch, light verifier, config, metrics -----------------
+
+
+def test_crypto_batch_routes_through_plane(plane):
+    pubs, msgs, sigs, exp = make_rows(7)
+    set_global_plane(plane)
+    before = plane.batches
+    got = cbatch.verify_batch(pubs, msgs, sigs)
+    np.testing.assert_array_equal(got, np.asarray(exp))
+    assert plane.batches > before
+    # pinned kernels/breaker stay on the direct path (tests, dispatcher)
+    brk = cbatch.CircuitBreaker()
+    direct = cbatch.verify_batch(pubs, msgs, sigs,
+                                 kernels={"ed25519": oracle_kernel},
+                                 breaker=brk)
+    np.testing.assert_array_equal(direct, np.asarray(exp))
+
+
+def test_plane_batch_fn_for_light_verifier(plane):
+    assert plane_batch_fn() is None  # no global plane registered
+    set_global_plane(plane)
+    fn = plane_batch_fn()
+    assert fn is not None
+    pubs, msgs, sigs, exp = make_rows(5)
+    np.testing.assert_array_equal(np.asarray(fn(pubs, msgs, sigs)),
+                                  np.asarray(exp))
+
+
+def test_config_section_and_validation(tmp_path):
+    from cometbft_tpu.config.config import (
+        Config,
+        ConfigError,
+        load_config,
+        save_config,
+    )
+
+    cfg = Config()
+    assert cfg.verify_plane.build() is None  # disabled by default
+    cfg.verify_plane.enable = True
+    cfg.verify_plane.window_ms = 2.5
+    cfg.verify_plane.max_batch = 64
+    cfg.verify_plane.max_queue = 128
+    cfg.validate_basic()
+    path = str(tmp_path / "config.toml")
+    save_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.verify_plane.enable is True
+    assert loaded.verify_plane.window_ms == 2.5
+    assert loaded.verify_plane.max_queue == 128
+    p = loaded.verify_plane.build()
+    try:
+        assert p is not None and p.window == pytest.approx(0.0025)
+    finally:
+        p.stop()
+    cfg.verify_plane.max_queue = 1  # < max_batch
+    with pytest.raises(ConfigError, match="max_queue"):
+        cfg.validate_basic()
+
+
+def test_plane_metrics_exposed(plane):
+    from cometbft_tpu.libs.metrics import NodeMetrics
+
+    m = NodeMetrics()
+    plane.metrics = m
+    pubs, msgs, sigs, _ = make_rows(4)
+    plane.submit_and_wait(pubs, msgs, sigs)
+    text = m.expose_text()
+    for name in (
+        "cometbft_verifyplane_queue_depth",
+        "cometbft_verifyplane_batch_size",
+        "cometbft_verifyplane_submit_to_result_seconds",
+        "cometbft_verifyplane_padding_waste_total",
+        "cometbft_crypto_breaker_open",
+    ):
+        assert name in text, name
+    # the flush recorded a batch and a latency observation
+    assert "cometbft_verifyplane_batch_size_count" in text
