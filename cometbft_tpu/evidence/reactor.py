@@ -4,6 +4,11 @@ Reference: evidence/reactor.go — EvidenceChannel 0x38 (:20), broadcast of
 evidence lists to peers (:39 broadcastEvidenceRoutine); received evidence
 goes through pool.AddEvidence (verify + dedupe) before relay, so invalid
 evidence costs the sender its connection and is never amplified.
+
+Both evidence kinds ride this channel: DuplicateVoteEvidence and
+LightClientAttackEvidence (the latter carries its conflicting-commit
+proof in the wire form, so the receiving pool can re-run
+verify_light_client_attack before relaying).
 """
 from __future__ import annotations
 

@@ -56,22 +56,101 @@ def verify_light_client_attack(
     ev: LightClientAttackEvidence,
     chain_id: str,
     common_vals: ValidatorSet,
-    conflicting_commit,
+    conflicting_commit=None,
     conflicting_vals: Optional[ValidatorSet] = None,
     trust_level=(1, 3),
     batch_fn: Optional[Callable] = None,
 ) -> None:
     """evidence/verify.go:110: the conflicting header must be sealed by
     (a) >=1/3 of the common-height set (VerifyCommitLightTrusting,
-    :123) and (b) 2/3+ of its own claimed set (VerifyCommitLight, :135)."""
+    :123) and (b) 2/3+ of its own claimed set (VerifyCommitLight, :135).
+
+    `conflicting_commit` defaults to the proof the evidence carries
+    (ev.conflicting_commit); the evidence pool and reactor verify
+    gossiped / block-included attacks through exactly this path. The
+    named byzantine validators must be members of the common-height set
+    AND signers of the conflicting commit (verify.go:150-186's
+    getByzantineValidators contract — naming an innocent validator makes
+    the evidence invalid, it must not reach the app's slashing logic)."""
     from cometbft_tpu.types import validation
 
     ev.validate_basic()
-    validation.verify_commit_light_trusting(
-        chain_id, common_vals, conflicting_commit, trust_level, batch_fn,
-    )
-    if conflicting_vals is not None:
-        validation.verify_commit_light(
-            chain_id, conflicting_vals, conflicting_commit.block_id,
-            conflicting_commit.height, conflicting_commit, batch_fn,
+    if conflicting_commit is None:
+        conflicting_commit = ev.conflicting_commit
+    if conflicting_commit is None:
+        raise EvidenceError(
+            "light client attack evidence carries no conflicting commit"
         )
+    # the proof must actually be about the claimed conflicting header
+    if conflicting_commit.height != ev.conflicting_height:
+        raise EvidenceError(
+            f"conflicting commit height {conflicting_commit.height} != "
+            f"evidence conflicting height {ev.conflicting_height}"
+        )
+    if conflicting_commit.block_id.hash != ev.conflicting_header_hash:
+        raise EvidenceError(
+            "conflicting commit seals a different header than the "
+            "evidence claims"
+        )
+    if ev.total_voting_power != common_vals.total_voting_power():
+        raise EvidenceError(
+            f"total power mismatch: evidence {ev.total_voting_power}, "
+            f"common set {common_vals.total_voting_power()}"
+        )
+    try:
+        conflicting_commit.validate_basic()
+    except Exception as e:  # noqa: BLE001 - malformed proof commit
+        raise EvidenceError(f"malformed conflicting commit: {e}")
+    # Each NAMED byzantine validator's commit signature is verified
+    # DIRECTLY here: the trusting verification below early-exits once
+    # 1/3 of power is tallied, so a commit row past that point is never
+    # examined — an unverified membership check would let an attacker
+    # append a forged for_block row naming an INNOCENT validator and
+    # have the slashing pipeline punish them.
+    sig_row = {
+        cs.validator_address: idx
+        for idx, cs in enumerate(conflicting_commit.signatures)
+        if cs.for_block()
+    }
+    for addr in ev.byzantine_validators:
+        _, val = common_vals.get_by_address(addr)
+        if val is None:
+            raise EvidenceError(
+                f"byzantine validator {addr.hex()} not in common set at "
+                f"height {ev.common_height}"
+            )
+        idx = sig_row.get(addr)
+        if idx is None:
+            raise EvidenceError(
+                f"byzantine validator {addr.hex()} did not sign the "
+                f"conflicting header"
+            )
+        cs = conflicting_commit.signatures[idx]
+        if not val.pub_key.verify_signature(
+            conflicting_commit.vote_sign_bytes(chain_id, idx),
+            cs.signature,
+        ):
+            raise EvidenceError(
+                f"byzantine validator {addr.hex()} named with a FORGED "
+                f"conflicting-commit signature"
+            )
+    try:
+        validation.verify_commit_light_trusting(
+            chain_id, common_vals, conflicting_commit, trust_level,
+            batch_fn,
+        )
+    except validation.VerificationError as e:
+        raise EvidenceError(
+            f"conflicting commit fails trusting verification: {e}"
+        )
+    if conflicting_vals is not None:
+        try:
+            validation.verify_commit_light(
+                chain_id, conflicting_vals, conflicting_commit.block_id,
+                conflicting_commit.height, conflicting_commit, batch_fn,
+            )
+        except validation.VerificationError as e:
+            raise EvidenceError(
+                f"conflicting commit fails light verification against "
+                f"its claimed set: {e}"
+            )

@@ -255,6 +255,29 @@ def registry() -> FailpointRegistry:
     return _REGISTRY
 
 
+def swap_registry(reg: FailpointRegistry) -> FailpointRegistry:
+    """Install `reg` as the process-global registry and return the old
+    one. The Byzantine simnet uses this to give every simulated node its
+    OWN failpoint registry: the (single-threaded) scheduler swaps a
+    node's registry in around that node's event execution, so a
+    ``Failpoint(node=2, ...)`` schedule op faults only node 2's seams.
+    Callers must restore the previous registry (try/finally)."""
+    global _REGISTRY
+    old = _REGISTRY
+    _REGISTRY = reg
+    return old
+
+
+def fresh_registry(crash_handler=None) -> FailpointRegistry:
+    """A standalone registry that never arms from the environment —
+    per-node simnet registries, isolated from CBT_FAILPOINTS."""
+    reg = FailpointRegistry()
+    reg._env_loaded = True
+    if crash_handler is not None:
+        reg.set_crash_handler(crash_handler)
+    return reg
+
+
 def register(name: str, doc: str = "") -> None:
     _REGISTRY.register(name, doc)
 

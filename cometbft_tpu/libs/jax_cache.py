@@ -1,0 +1,31 @@
+"""Persistent JAX compilation-cache knobs, shared by the test suite
+(tests/conftest.py) and the driver entry (__graft_entry__.py).
+
+The interpret-mode Pallas verify kernel costs minutes per compile on a
+1-core CPU host; with the on-disk cache enabled only the first-ever run
+pays (cache keys include backend + jax version, so TPU runs are
+unaffected). One helper so the two call sites can never drift apart and
+silently split the cache.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_CACHE_DIR = "/tmp/cbt_jax_cache"
+ENV_VAR = "CBT_JAX_CACHE_DIR"
+
+
+def enable_persistent_compile_cache(
+    cache_dir: Optional[str] = None,
+) -> str:
+    """Point jax at the shared on-disk compilation cache; returns the
+    directory used. Safe to call repeatedly."""
+    import jax
+
+    path = cache_dir or os.environ.get(ENV_VAR, DEFAULT_CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    return path

@@ -277,32 +277,41 @@ class Client:
                               conflicting: LightBlock):
         """LightClientAttackEvidence from a conflicting light block
         (types/evidence.go:193): byzantine validators are the conflicting
-        commit's signers that are also in the trusted set at that height
-        (the lunatic/equivocation overlap, evidence.go GetByzantine...)."""
+        commit's signers that are also in the COMMON-height set — full
+        nodes verify the evidence against the common set
+        (verify_light_client_attack), so the power snapshot and the
+        byzantine list must come from that set or legitimate evidence
+        is rejected whenever the valset rotated between the common and
+        conflicting heights (evidence.go GetByzantineValidators)."""
         from cometbft_tpu.types.evidence import LightClientAttackEvidence
 
         commit = conflicting.signed_header.commit
         if commit is None:
             return None
-        trusted_vals = verified.validator_set
-        byz = []
-        for cs in commit.signatures:
-            if not cs.for_block():
-                continue
-            _, val = trusted_vals.get_by_address(cs.validator_address)
-            if val is not None:
-                byz.append(cs.validator_address)
         common = max(
             (h for h in self.store.heights() if h < verified.height),
             default=verified.height,
         )
+        common_lb = self.store.get(common)
+        common_vals = (common_lb.validator_set if common_lb is not None
+                       else verified.validator_set)
+        byz = []
+        for cs in commit.signatures:
+            if not cs.for_block():
+                continue
+            _, val = common_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                byz.append(cs.validator_address)
         return LightClientAttackEvidence(
             conflicting_header_hash=conflicting.signed_header.header.hash(),
             conflicting_height=conflicting.height,
             common_height=common,
             byzantine_validators=byz,
-            total_voting_power=trusted_vals.total_voting_power(),
+            total_voting_power=common_vals.total_voting_power(),
             timestamp=conflicting.signed_header.header.time,
+            # attach the proof so full nodes can re-verify the attack
+            # (evidence pool -> verify_light_client_attack)
+            conflicting_commit=commit,
         )
 
     # -- maintenance -------------------------------------------------------

@@ -148,7 +148,8 @@ class Node(BaseService):
         from cometbft_tpu.evidence.pool import EvidencePool
 
         self.evidence_pool = EvidencePool(
-            state.chain_id, self.state_store.load_validators
+            state.chain_id, self.state_store.load_validators,
+            batch_fn=batch_fn,
         )
         self.evidence_pool.height = state.last_block_height
         self.evidence_pool.time_s = state.last_block_time.seconds

@@ -9,6 +9,7 @@ connection (p2p/peer.go).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -182,18 +183,44 @@ class Switch(BaseService):
             r.remove_peer(peer, reason)
         _log.info("peer %s stopped: %s", peer.peer_id[:12], reason)
 
+    # redial backoff knobs (p2p/switch.go reconnectToPeer: exponential
+    # backoff with jitter — without the jitter, every peer of a healed
+    # partition redials the same instant and the accept queues thundering-
+    # herd; the simnet's heal schedules exposed exactly that)
+    REDIAL_BASE = 0.25
+    REDIAL_MAX = 10.0
+
+    @staticmethod
+    def _next_backoff(delay: float, rng=random):
+        """(jittered wait, new base delay) after a failure: exponential
+        growth capped at REDIAL_MAX, plus up to 50% random jitter so
+        concurrently-failing dialers decorrelate."""
+        base = min(Switch.REDIAL_MAX,
+                   max(Switch.REDIAL_BASE, delay * 2.0))
+        return base * (1.0 + 0.5 * rng.random()), base
+
     def _redial_loop(self) -> None:
+        # node_id -> (next attempt monotonic time, current base delay)
+        backoff: Dict[str, tuple] = {}
         while self.is_running():
+            now = time.monotonic()
             for node_id, addr in list(self.persistent.items()):
                 with self._peers_lock:
                     have = node_id in self.peers
-                if not have:
-                    try:
-                        fp.fail_point("p2p.dial")
-                        self.transport.dial(addr)
-                    except Exception:  # noqa: BLE001
-                        pass
-            time.sleep(0.5)
+                if have:
+                    backoff.pop(node_id, None)
+                    continue
+                next_try, delay = backoff.get(node_id, (0.0, 0.0))
+                if now < next_try:
+                    continue
+                try:
+                    fp.fail_point("p2p.dial")
+                    self.transport.dial(addr)
+                    backoff.pop(node_id, None)
+                except Exception:  # noqa: BLE001
+                    wait, base = self._next_backoff(delay)
+                    backoff[node_id] = (time.monotonic() + wait, base)
+            time.sleep(0.1)
 
     # -- messaging ---------------------------------------------------------
 

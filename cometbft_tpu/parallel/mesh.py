@@ -19,10 +19,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cometbft_tpu.ops import ed25519_kernel as ek
 
+# jax.shard_map went top-level in 0.5.x; older containers only have the
+# experimental module (and spell the unchecked-replication kwarg
+# check_rep instead of check_vma). One shim keeps every builder below
+# running on both.
+if hasattr(jax, "shard_map"):
+    _shard_map, _UNCHECKED_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - exercised on jax<0.5 containers
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _UNCHECKED_KW = "check_rep"
+
+
+def _smap(fn, mesh, in_specs, out_specs, unchecked: bool = False):
+    kw = {_UNCHECKED_KW: False} if unchecked else {}
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
 
 def make_mesh(devices=None, axis: str = "batch") -> Mesh:
     devices = jax.devices() if devices is None else devices
     return Mesh(np.asarray(devices), (axis,))
+
+
+# Compiled-step memo (round-5 regression fix): every builder below used
+# to return a FRESH jax.jit(shard_map(...)) closure per call, so two
+# calls with the same mesh re-traced — and on CPU interpret-compiled the
+# Pallas kernel again, minutes each. Steps are cached by
+# (builder, mesh identity, n_commits); jit's own cache handles row-shape
+# specialization within a step.
+_STEP_CACHE: dict = {}
+
+
+def _mesh_key(mesh: Mesh):
+    return (tuple(mesh.axis_names), tuple(mesh.devices.flat))
 
 
 def _carry_tally(t):
@@ -39,8 +69,12 @@ def sharded_verify_tally(mesh: Mesh, n_commits: int):
     Returns a jitted fn with the same signature as
     ed25519_kernel.verify_tally_kernel (minus n_commits). Batch dims shard
     over the mesh axis; tallies are psum-reduced; threshold/quorum are
-    replicated.
+    replicated. Memoized per (mesh, n_commits).
     """
+    key = ("xla", _mesh_key(mesh), int(n_commits))
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
     bspec = P(axis)
     rspec = P()
@@ -54,13 +88,80 @@ def sharded_verify_tally(mesh: Mesh, n_commits: int):
         quorum = ek.quorum_core(total, threshold)
         return valid, total, quorum
 
-    sharded = jax.shard_map(
+    sharded = _smap(
         step,
         mesh=mesh,
         in_specs=(bspec,) * 7 + (bspec, bspec, bspec, rspec),
         out_specs=(bspec, rspec, rspec),
     )
-    return jax.jit(sharded)
+    fn = jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def _sharded_verify_rows_step(mesh: Mesh):
+    """The EXPENSIVE half of the rows path: the Mosaic/Pallas verify
+    kernel (plus cheap per-row column extraction) under shard_map.
+    Independent of n_commits, so every tally width shares this one
+    compiled program — the round-5 multichip regression was exactly this
+    program compiling once per (call, n_commits)."""
+    key = ("pallas-verify", _mesh_key(mesh))
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    from cometbft_tpu.ops import ed25519_pallas as kp
+
+    axis = mesh.axis_names[0]
+
+    def vstep(rows, base):
+        valid = kp._verify_rows.__wrapped__(rows, base)
+        pw = rows[kp.C_POW:kp.C_POW + 3]
+        power5 = jax.numpy.stack(
+            [pw[0] & kp._M13, pw[0] >> 13, pw[1] & kp._M13,
+             pw[1] >> 13, pw[2]], axis=1)
+        counted = (rows[kp.C_FLAGS] >> 3) & 1 != 0
+        commit_ids = rows[kp.C_CID]
+        return valid, power5, counted, commit_ids
+
+    sharded = _smap(
+        vstep,
+        mesh=mesh,
+        in_specs=(P(None, axis), P()),
+        out_specs=(P(axis), P(axis, None), P(axis), P(axis)),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation;
+        # the specs above pin the sharding explicitly
+        unchecked=True,
+    )
+    fn = jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def _sharded_tally_step(mesh: Mesh, n_commits: int):
+    """The CHEAP half: per-device tally einsum + psum + quorum. A fresh
+    trace per n_commits costs seconds, not the Pallas kernel's minutes."""
+    key = ("pallas-tally", _mesh_key(mesh), int(n_commits))
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    axis = mesh.axis_names[0]
+
+    def tstep(valid, power5, counted, commit_ids, threshold):
+        local = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
+        total = _carry_tally(jax.lax.psum(local, axis))
+        quorum = ek.quorum_core(total, threshold)
+        return total, quorum
+
+    sharded = _smap(
+        tstep,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis, None), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        unchecked=True,
+    )
+    fn = jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn
 
 
 def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
@@ -71,34 +172,28 @@ def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
     multiple of ed25519_pallas.B_TILE), computes its partial power tally,
     and one psum over the mesh reduces per-commit tallies. Thresholds ride
     as a separate replicated argument (they are per-commit, not per-row,
-    so they must not be lane-sharded with the rows)."""
-    from cometbft_tpu.ops import ed25519_pallas as kp
+    so they must not be lane-sharded with the rows).
 
-    axis = mesh.axis_names[0]
+    Two compiled programs compose the step: the n_commits-independent
+    Pallas verify (shared by ALL tally widths on a mesh) and a tiny
+    per-n_commits tally+psum jit. Both are memoized, so repeated calls —
+    the round-5 multichip regression — reuse the compiled closures
+    instead of re-tracing."""
+    key = ("rows", _mesh_key(mesh), int(n_commits))
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    verify = _sharded_verify_rows_step(mesh)
+    tally = _sharded_tally_step(mesh, n_commits)
 
-    def step(rows, base, threshold):
-        valid = kp._verify_rows.__wrapped__(rows, base)
-        pw = rows[kp.C_POW:kp.C_POW + 3]
-        power5 = jax.numpy.stack(
-            [pw[0] & kp._M13, pw[0] >> 13, pw[1] & kp._M13,
-             pw[1] >> 13, pw[2]], axis=1)
-        counted = (rows[kp.C_FLAGS] >> 3) & 1 != 0
-        commit_ids = rows[kp.C_CID]
-        local = ek.tally_core(valid, power5, counted, commit_ids, n_commits)
-        total = _carry_tally(jax.lax.psum(local, axis))
-        quorum = ek.quorum_core(total, threshold)
+    def fn(rows, base, threshold):
+        valid, power5, counted, commit_ids = verify(rows, base)
+        total, quorum = tally(valid, power5, counted, commit_ids,
+                              threshold)
         return valid, total, quorum
 
-    sharded = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(None, axis), P(), P()),
-        out_specs=(P(axis), P(), P()),
-        # pallas_call's out_shape carries no varying-mesh-axes annotation;
-        # the specs above pin the sharding explicitly
-        check_vma=False,
-    )
-    return jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn
 
 
 def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
@@ -146,6 +241,10 @@ def sharded_stream_verify(mesh: Mesh, n_commits: int):
     """
     from cometbft_tpu.ops import ed25519_cached as ec
 
+    key = ("stream", _mesh_key(mesh), int(n_commits))
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
 
     def step(rows, tab, ok, power5, base, threshold):
@@ -156,11 +255,13 @@ def sharded_stream_verify(mesh: Mesh, n_commits: int):
         quorum = ek.quorum_core(total, threshold)
         return valid, total, quorum
 
-    sharded = jax.shard_map(
+    sharded = _smap(
         step,
         mesh=mesh,
         in_specs=(P(None, axis), P(), P(), P(), P(), P()),
         out_specs=(P(axis), P(), P()),
-        check_vma=False,
+        unchecked=True,
     )
-    return jax.jit(sharded)
+    fn = jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn

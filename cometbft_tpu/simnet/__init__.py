@@ -1,0 +1,17 @@
+"""Byzantine simnet: deterministic in-process adversarial network
+simulation (see simnet/core.py for the architecture)."""
+from cometbft_tpu.simnet.core import Link, SimNetwork, SimNode
+from cometbft_tpu.simnet.harness import Simnet, SimnetFailure
+from cometbft_tpu.simnet.schedule import (
+    ScheduleError,
+    random_schedule,
+    schedule_from_json,
+    schedule_to_json,
+    validate_schedule,
+)
+
+__all__ = [
+    "Link", "SimNetwork", "SimNode", "Simnet", "SimnetFailure",
+    "ScheduleError", "random_schedule", "schedule_from_json",
+    "schedule_to_json", "validate_schedule",
+]

@@ -1,0 +1,110 @@
+"""Byzantine validator actors for the simnet.
+
+Three behaviors from the adversarial-consensus literature
+(arXiv:2302.00418 treats equivocation detection and batch verification
+of adversarial inputs as first-class; CometBFT's e2e runner injects the
+same classes):
+
+  * equivocator — double-signs prevotes/precommits. Honest nodes must
+    surface it as DuplicateVoteEvidence (consensus/height_vote_set.py
+    conflict detection -> evidence/pool.py -> block inclusion ->
+    mark_committed).
+  * garbage signer — gossips syntactically-valid votes with forged
+    signatures. The verify path (host or verify plane) must reject them
+    without poisoning coalesced batches and without tripping the
+    circuit breaker (a bad SIGNATURE is a verdict, not a device fault).
+  * light-client attacker — a >=1/3 coalition signs a forged header at
+    a committed height; the resulting LightClientAttackEvidence (with
+    its conflicting-commit proof attached) must pass
+    verify_light_client_attack on honest nodes and flow through the
+    same pool -> block -> mark_committed pipeline.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import replace
+from typing import List
+
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import (
+    BLOCK_ID_FLAG_COMMIT,
+    Commit,
+    CommitSig,
+)
+from cometbft_tpu.types.evidence import LightClientAttackEvidence
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import Vote
+
+
+def _fake_block_id(tag: bytes) -> BlockID:
+    h = hashlib.sha256(b"simnet-byzantine-" + tag).digest()
+    return BlockID(h, PartSetHeader(1, h))
+
+
+def conflicting_vote(vote: Vote, priv, chain_id: str) -> Vote:
+    """The equivocator's second signature: same (height, round, type),
+    different block ID, properly signed with the RAW private key —
+    FilePV would refuse (privval/file_pv.py double-sign guard), which is
+    precisely why a byzantine signer doesn't use it."""
+    bad = replace(
+        vote,
+        block_id=_fake_block_id(b"%d-%d-%d" % (
+            vote.height, vote.round, vote.vote_type
+        )),
+        signature=b"", extension=b"", extension_signature=b"",
+    )
+    bad.signature = priv.sign(bad.sign_bytes(chain_id))
+    return bad
+
+
+def garbage_sign(vote: Vote, rng) -> Vote:
+    """The garbage signer's output: the vote with a seeded-random 64-byte
+    forgery in place of the signature (still structurally valid, so it
+    reaches signature verification — and, when a verify plane runs,
+    coalesces into shared device batches)."""
+    return replace(vote, signature=bytes(rng.getrandbits(8)
+                                         for _ in range(64)))
+
+
+def build_light_attack(privs, valset, chain_id: str,
+                       byz_idxs: List[int], height: int,
+                       now: Timestamp) -> LightClientAttackEvidence:
+    """Forge a conflicting header at `height` sealed by the byzantine
+    coalition, and package it as LightClientAttackEvidence with the
+    commit proof attached.
+
+    The coalition must hold >= 1/3 of the voting power at `height` for
+    the evidence to verify (types/validation.py
+    verify_commit_light_trusting with the default (1, 3) trust level) —
+    the same threshold a real light-client attack needs."""
+    forged = hashlib.sha256(
+        b"simnet-forged-header-%d" % height
+    ).digest()
+    bid = BlockID(forged, PartSetHeader(1, forged))
+    sigs = [CommitSig.absent() for _ in range(len(valset))]
+    byz_addrs = []
+    for idx in byz_idxs:
+        priv = privs[idx]
+        addr = priv.pub_key().address()
+        vidx, val = valset.get_by_address(addr)
+        assert val is not None, "byzantine index not in validator set"
+        v = Vote(
+            vote_type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+            block_id=bid, timestamp=now, validator_address=addr,
+            validator_index=vidx,
+        )
+        sigs[vidx] = CommitSig(
+            BLOCK_ID_FLAG_COMMIT, addr, now,
+            priv.sign(v.sign_bytes(chain_id)),
+        )
+        byz_addrs.append(addr)
+    return LightClientAttackEvidence(
+        conflicting_header_hash=forged,
+        conflicting_height=height,
+        common_height=height,
+        byzantine_validators=byz_addrs,
+        total_voting_power=valset.total_voting_power(),
+        timestamp=now,
+        conflicting_commit=Commit(height, 0, bid, sigs),
+    )

@@ -1,0 +1,267 @@
+"""The simnet harness: schedules -> runs -> safety/liveness/evidence
+assertions, with seed+schedule replay on every failure.
+
+This is the scenario-coverage engine the ROADMAP's perf PRs validate
+against: any consensus/evidence/verify-plane change can be driven
+through partitions, byzantine actors, crashes, and failpoint faults in
+deterministic, replayable simulated time.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.simnet import actors
+from cometbft_tpu.simnet.core import EVIDENCE_CHANNEL, SimNetwork
+from cometbft_tpu.simnet.schedule import (
+    schedule_to_json,
+    validate_schedule,
+)
+
+
+class SimnetFailure(AssertionError):
+    """A simnet assertion failed. str() carries the replay blob — feed
+    it back through Simnet(seed=...).run(schedule) (or
+    tools/simnet_fuzz.py --replay). For single-run schedules (the
+    fuzzer's shape) the rerun is byte-identical; a MULTI-phase scenario
+    (several run() calls with mid-run assertions) additionally needs
+    its phase boundaries — rerun the originating test, whose code IS
+    that phase structure."""
+
+    def __init__(self, msg: str, seed: int, schedule: List[Dict]):
+        self.seed = seed
+        self.schedule = schedule
+        super().__init__(
+            f"{msg}\nreplay: {schedule_to_json(seed, schedule)}"
+        )
+
+
+class Simnet:
+    """Build-run-assert wrapper around :class:`SimNetwork`."""
+
+    def __init__(self, n_nodes: int, seed: int, basedir: str, **kw):
+        self.net = SimNetwork(n_nodes, seed, basedir, **kw)
+        self.schedule: List[Dict] = []
+        self._started = False
+
+    # -- running -----------------------------------------------------------
+
+    def run(self, schedule: List[Dict],
+            until: Optional[Callable[[], bool]] = None,
+            until_height: Optional[int] = None,
+            max_time: float = 120.0) -> bool:
+        """Apply `schedule` and run simulated time forward until the
+        condition holds (or `max_time` more simulated seconds pass).
+        Reentrant: later run() calls continue the same simulation with
+        additional schedule ops."""
+        net = self.net
+        validate_schedule(schedule, len(net.nodes))
+        self.schedule = sorted(
+            self.schedule + [dict(op) for op in schedule],
+            key=lambda o: float(o["at"]),
+        )
+        if not self._started:
+            self._started = True
+            net.start()
+        for op in schedule:
+            delay = max(0.0, float(op["at"]) - net.now)
+            net.schedule(delay, lambda op=op: self._apply(op),
+                         f"op:{op['op']}")
+        if until is None and until_height is not None:
+            target = until_height
+            until = lambda: all(  # noqa: E731
+                n.height() >= target for n in net.nodes if n.alive
+            ) and any(n.alive for n in net.nodes)
+        return net.run_until(until, max_time=net.now + max_time)
+
+    def close(self) -> None:
+        self.net.close()
+
+    def __enter__(self) -> "Simnet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- schedule ops ------------------------------------------------------
+
+    def _apply(self, op: Dict) -> None:
+        net = self.net
+        kind = op["op"]
+        if kind == "partition":
+            groups = [set(g) for g in op["groups"]]
+            group_of = {}
+            for gi, g in enumerate(groups):
+                for i in g:
+                    group_of[i] = gi
+            for (i, j), link in net.links.items():
+                link.up = (i in group_of and j in group_of
+                           and group_of[i] == group_of[j])
+        elif kind == "heal":
+            for link in net.links.values():
+                link.up = True
+                link.drop = link.dup = link.reorder = 0.0
+                link.jitter = 0.0
+        elif kind == "link":
+            frm = op.get("frm")
+            to = op.get("to")
+            for (i, j), link in net.links.items():
+                if frm is not None and i not in frm:
+                    continue
+                if to is not None and j not in to:
+                    continue
+                for key in ("drop", "delay", "jitter", "dup", "reorder"):
+                    if key in op:
+                        setattr(link, key, float(op[key]))
+        elif kind == "kill":
+            net.nodes[op["node"]].halt("schedule kill")
+        elif kind == "restart":
+            node = net.nodes[op["node"]]
+            if not node.alive:
+                node.restart()
+        elif kind == "failpoint":
+            net.nodes[op["node"]].registry.arm_from_spec(op["spec"])
+        elif kind == "equivocate":
+            net.nodes[op["node"]].equivocate_budget += int(
+                op.get("votes", 1)
+            )
+        elif kind == "garbage":
+            net.nodes[op["node"]].garbage_budget += int(op.get("votes", 1))
+        elif kind == "light_attack":
+            self._launch_light_attack(op)
+        elif kind == "tx":
+            node = net.nodes[op["node"]]
+            if node.alive:
+                node.node.mempool.check_tx(bytes.fromhex(op["data"]))
+
+    def _launch_light_attack(self, op: Dict) -> None:
+        net = self.net
+        target = net.nodes[op["target"]]
+        if not target.alive:
+            return
+        height = int(op.get("height", 1))
+        ev = actors.build_light_attack(
+            net.privs, net.genesis.validators, net.chain_id,
+            [int(i) for i in op["byz"]], height, net._sim_now(),
+        )
+        import json
+
+        from cometbft_tpu.types.evidence import evidence_to_j
+
+        net._deliver(target.idx, EVIDENCE_CHANNEL,
+                     json.dumps(evidence_to_j(ev)).encode())
+
+    # -- assertions --------------------------------------------------------
+
+    def _fail(self, msg: str) -> "SimnetFailure":
+        return SimnetFailure(msg, self.net.seed, self.schedule)
+
+    def commit_hashes(self) -> List[Dict[int, bytes]]:
+        """Per-node height -> committed block hash (incl. killed nodes'
+        pre-crash history)."""
+        for n in self.net.nodes:
+            if n.alive:
+                n._record_commits()
+        return [dict(n.commit_hashes) for n in self.net.nodes]
+
+    def assert_safety(self) -> None:
+        """No two nodes ever committed different blocks at one height."""
+        per_node = self.commit_hashes()
+        agreed: Dict[int, bytes] = {}
+        owner: Dict[int, int] = {}
+        for idx, hashes in enumerate(per_node):
+            for h, bh in hashes.items():
+                if h in agreed and agreed[h] != bh:
+                    raise self._fail(
+                        f"SAFETY VIOLATION at height {h}: node "
+                        f"{owner[h]} committed {agreed[h].hex()[:16]}, "
+                        f"node {idx} committed {bh.hex()[:16]}"
+                    )
+                agreed.setdefault(h, bh)
+                owner.setdefault(h, idx)
+
+    def assert_liveness(self, min_new_heights: int = 2,
+                        max_time: float = 30.0) -> None:
+        """After the schedule (heal included), the chain must still
+        grow: every ALIVE node gains >= min_new_heights. Requires a
+        live quorum — with > 1/3 of power dead the assertion is
+        vacuous and raises a schedule error instead."""
+        net = self.net
+        alive = [n for n in net.nodes if n.alive]
+        if 3 * len(alive) <= 2 * len(net.nodes):
+            raise self._fail(
+                "liveness asserted without a live 2/3 quorum "
+                f"({len(alive)}/{len(net.nodes)} alive)"
+            )
+        floor = min(n.height() for n in alive)
+        target = floor + min_new_heights
+        ok = net.run_until(
+            lambda: all(n.height() >= target
+                        for n in net.nodes if n.alive),
+            max_time=net.now + max_time,
+        )
+        if not ok:
+            heights = {n.idx: n.height() for n in net.nodes if n.alive}
+            raise self._fail(
+                f"LIVENESS failure: wanted height {target} on every "
+                f"live node within {max_time}s sim time, got {heights}"
+            )
+
+    def assert_evidence_committed(self, predicate=None,
+                                  max_time: float = 30.0) -> object:
+        """Run until some node's committed chain contains evidence
+        (optionally matching `predicate`); returns the evidence object.
+        Every node must then reach that height with the same block."""
+        net = self.net
+        found: list = []
+        scanned: Dict[int, int] = {}  # node idx -> last height scanned
+
+        def scan() -> bool:
+            for n in net.nodes:
+                if not n.alive:
+                    continue
+                tip = n.height()
+                for h in range(scanned.get(n.idx, 0) + 1, tip + 1):
+                    scanned[n.idx] = h
+                    blk = n.node.block_store.load_block(h)
+                    if blk is None or not blk.evidence:
+                        continue
+                    for ev in blk.evidence:
+                        if predicate is None or predicate(ev):
+                            found.append((n.idx, h, ev))
+                            return True
+            return False
+
+        if not net.run_until(scan, max_time=net.now + max_time):
+            sizes = {n.idx: n.node.evidence_pool.size()
+                     for n in net.nodes if n.alive}
+            raise self._fail(
+                f"EVIDENCE never committed (pending pools: {sizes})"
+            )
+        idx, h, ev = found[0]
+        # committed on every live node, same block
+        ref = self.net.nodes[idx].node.block_store.load_block(h).hash()
+        ok = net.run_until(
+            lambda: all(n.height() >= h for n in net.nodes if n.alive),
+            max_time=net.now + max_time,
+        )
+        if not ok:
+            raise self._fail(
+                f"evidence block {h} not replicated to every live node"
+            )
+        for n in net.nodes:
+            if not n.alive:
+                continue
+            blk = n.node.block_store.load_block(h)
+            if blk is None or blk.hash() != ref:
+                raise self._fail(
+                    f"node {n.idx} disagrees on evidence block {h}"
+                )
+        # the pool moved it pending -> committed
+        key = ev.hash()
+        for n in net.nodes:
+            if n.alive and key in n.node.evidence_pool._pending:
+                raise self._fail(
+                    f"node {n.idx} still holds committed evidence as "
+                    f"pending"
+                )
+        return ev

@@ -1,0 +1,168 @@
+"""Declarative fault schedules for the simnet.
+
+A schedule is a JSON-serializable list of timed ops — the analog of
+CometBFT's e2e perturbation plans (test/e2e/runner/perturb.go), but
+deterministic and replayable: `(seed, schedule)` fully determines a run,
+and every harness assertion failure prints both.
+
+Op catalog (each op is a plain dict, `at` in simulated seconds):
+
+  {"at": t, "op": "partition", "groups": [[0,1],[2,3]]}
+      Nodes communicate only within their group (links across groups go
+      down). Unlisted nodes are isolated.
+  {"at": t, "op": "heal"}
+      All links up, fault probabilities reset to zero.
+  {"at": t, "op": "link", "frm": [..], "to": [..], "drop": p,
+   "delay": s, "jitter": s, "dup": p, "reorder": p}
+      Set fault parameters on the selected directed links (omit
+      frm/to for all links; only the keys present are changed).
+  {"at": t, "op": "kill", "node": i}
+      Crash-halt node i (no graceful teardown; stores/WAL stay on disk).
+  {"at": t, "op": "restart", "node": i}
+      Rebuild node i over its home dir (WAL recovery + handshake replay).
+  {"at": t, "op": "failpoint", "node": i, "spec": "name=action[..]"}
+      Arm a libs/failpoints spec on node i's PRIVATE registry.
+  {"at": t, "op": "equivocate", "node": i, "votes": k}
+      Node i double-signs its next k own non-nil votes.
+  {"at": t, "op": "garbage", "node": i, "votes": k}
+      Node i's next k own votes leave with garbage signatures.
+  {"at": t, "op": "light_attack", "byz": [..], "target": i,
+   "height": h}
+      Deliver a forged-header LightClientAttackEvidence (signed by the
+      byz validators at height h) to node i as evidence gossip.
+  {"at": t, "op": "tx", "node": i, "data": "<hex>"}
+      Inject a transaction into node i's mempool.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+OPS = ("partition", "heal", "link", "kill", "restart", "failpoint",
+       "equivocate", "garbage", "light_attack", "tx")
+
+_LINK_KEYS = ("drop", "delay", "jitter", "dup", "reorder")
+
+
+class ScheduleError(Exception):
+    pass
+
+
+def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
+    """Structural validation so a typo'd schedule fails loudly up front
+    instead of silently perturbing nothing."""
+    for op in schedule:
+        if not isinstance(op, dict) or "op" not in op or "at" not in op:
+            raise ScheduleError(f"malformed op {op!r}")
+        kind = op["op"]
+        if kind not in OPS:
+            raise ScheduleError(f"unknown op {kind!r}")
+        if float(op["at"]) < 0:
+            raise ScheduleError(f"negative time in {op!r}")
+        for key in ("node", "target"):
+            if key in op and not 0 <= int(op[key]) < n_nodes:
+                raise ScheduleError(f"{key} out of range in {op!r}")
+        for key in ("byz", "frm", "to"):
+            sel = op.get(key, [])
+            if not isinstance(sel, (list, tuple)):
+                raise ScheduleError(
+                    f"{key} must be a list of node ids in {op!r}"
+                )
+            for i in sel:
+                if not 0 <= int(i) < n_nodes:
+                    raise ScheduleError(
+                        f"{key} node out of range in {op!r}"
+                    )
+        if kind == "partition":
+            seen = set()
+            for grp in op.get("groups", []):
+                for i in grp:
+                    if not 0 <= int(i) < n_nodes or i in seen:
+                        raise ScheduleError(f"bad partition {op!r}")
+                    seen.add(i)
+        if kind == "failpoint":
+            from cometbft_tpu.libs.failpoints import parse_spec
+
+            parse_spec(op.get("spec", ""))  # raises on malformed specs
+
+
+def schedule_to_json(seed: int, schedule: List[Dict]) -> str:
+    """The replay blob printed on every simnet failure."""
+    return json.dumps({"seed": seed, "schedule": schedule}, sort_keys=True)
+
+
+def schedule_from_json(blob: str):
+    j = json.loads(blob)
+    return j["seed"], j["schedule"]
+
+
+def random_schedule(rng, n_nodes: int, horizon: float = 20.0,
+                    n_ops: int = 6) -> List[Dict]:
+    """A seeded random schedule for the fuzzer (tools/simnet_fuzz.py):
+    draws from the full op catalog, keeps kills bounded so quorum can
+    survive, and always heals before the horizon so liveness is
+    checkable afterwards."""
+    ops: List[Dict] = []
+    killed: set = set()
+    max_kill = max(0, (n_nodes - 1) // 3)
+    for _ in range(n_ops):
+        at = round(rng.uniform(1.0, horizon * 0.6), 3)
+        kind = rng.choice(
+            ["partition", "link", "kill_restart", "failpoint",
+             "equivocate", "garbage", "tx"]
+        )
+        if kind == "partition":
+            cut = rng.randrange(1, n_nodes)
+            idxs = list(range(n_nodes))
+            rng.shuffle(idxs)
+            ops.append({"at": at, "op": "partition",
+                        "groups": [sorted(idxs[:cut]),
+                                   sorted(idxs[cut:])]})
+            ops.append({"at": round(at + rng.uniform(1.0, 4.0), 3),
+                        "op": "heal"})
+        elif kind == "link":
+            ops.append({
+                "at": at, "op": "link",
+                "drop": round(rng.uniform(0.0, 0.2), 3),
+                "delay": round(rng.uniform(0.005, 0.05), 4),
+                "jitter": round(rng.uniform(0.0, 0.02), 4),
+                "dup": round(rng.uniform(0.0, 0.1), 3),
+                "reorder": round(rng.uniform(0.0, 0.1), 3),
+            })
+        elif kind == "kill_restart":
+            if len(killed) >= max_kill:
+                continue
+            victim = rng.randrange(n_nodes)
+            killed.add(victim)
+            ops.append({"at": at, "op": "kill", "node": victim})
+            ops.append({"at": round(at + rng.uniform(1.0, 4.0), 3),
+                        "op": "restart", "node": victim})
+        elif kind == "failpoint":
+            node = rng.randrange(n_nodes)
+            point = rng.choice([
+                "consensus.wal.pre_vote", "consensus.wal.post_vote",
+                "consensus.wal.pre_proposal", "consensus.pre_finalize",
+            ])
+            action = rng.choice(["raise", "crash"])
+            ops.append({"at": at, "op": "failpoint", "node": node,
+                        "spec": f"{point}={action}*1"})
+            if action == "crash":
+                ops.append({"at": round(at + rng.uniform(1.0, 4.0), 3),
+                            "op": "restart", "node": node})
+        elif kind == "equivocate":
+            ops.append({"at": at, "op": "equivocate",
+                        "node": rng.randrange(n_nodes), "votes": 1})
+        elif kind == "garbage":
+            ops.append({"at": at, "op": "garbage",
+                        "node": rng.randrange(n_nodes),
+                        "votes": rng.randrange(1, 4)})
+        else:
+            ops.append({"at": at, "op": "tx",
+                        "node": rng.randrange(n_nodes),
+                        "data": bytes(
+                            f"k{rng.randrange(1000)}=v", "ascii"
+                        ).hex()})
+    # terminal heal so post-schedule liveness is meaningful
+    ops.append({"at": round(horizon * 0.7, 3), "op": "heal"})
+    ops.sort(key=lambda o: o["at"])
+    return ops

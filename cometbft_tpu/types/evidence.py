@@ -89,7 +89,17 @@ class DuplicateVoteEvidence:
 class LightClientAttackEvidence:
     """A conflicting light block presented to a light client
     (types/evidence.go:193). Carried with the common height and the
-    byzantine validator snapshot."""
+    byzantine validator snapshot.
+
+    `conflicting_commit` is the attached PROOF: the +1/3 commit sealing
+    the forged header (the reference carries the whole ConflictingBlock,
+    and hashes it — evidence.go LightClientAttackEvidence.Hash covers
+    ConflictingBlock). The proof IS part of bytes()/hash() here too:
+    were it excluded, a relayer could strip or corrupt the proof
+    without changing the evidence hash, making one block's
+    evidence_hash verify on nodes that already hold the evidence
+    pending and fail on nodes seeing it fresh — honest nodes
+    disagreeing about one block hash."""
 
     conflicting_header_hash: bytes
     conflicting_height: int
@@ -97,13 +107,14 @@ class LightClientAttackEvidence:
     byzantine_validators: List[bytes] = field(default_factory=list)
     total_voting_power: int = 0
     timestamp: Timestamp = field(default_factory=Timestamp)
+    conflicting_commit: Optional[object] = None  # types.commit.Commit
 
     @property
     def height(self) -> int:
         return self.common_height
 
     def bytes(self) -> bytes:
-        return json.dumps({
+        j = {
             "t": "light_client_attack",
             "h": self.conflicting_header_hash.hex(),
             "ch": self.conflicting_height,
@@ -111,7 +122,10 @@ class LightClientAttackEvidence:
             "byz": [a.hex() for a in self.byzantine_validators],
             "tvp": self.total_voting_power,
             "ts": serde.ts_to_j(self.timestamp),
-        }, sort_keys=True).encode()
+        }
+        if self.conflicting_commit is not None:
+            j["commit"] = serde.commit_to_j(self.conflicting_commit)
+        return json.dumps(j, sort_keys=True).encode()
 
     def hash(self) -> bytes:
         return hashlib.sha256(self.bytes()).digest()
@@ -132,6 +146,7 @@ def evidence_to_j(ev) -> dict:
     if isinstance(ev, DuplicateVoteEvidence):
         return json.loads(ev.bytes().decode())
     if isinstance(ev, LightClientAttackEvidence):
+        # bytes() already carries the proof commit (hash-covered)
         return json.loads(ev.bytes().decode())
     raise EvidenceError(f"unknown evidence type {type(ev)}")
 
@@ -147,5 +162,6 @@ def evidence_from_j(j: dict):
             bytes.fromhex(j["h"]), j["ch"], j["common"],
             [bytes.fromhex(a) for a in j["byz"]], j["tvp"],
             serde.ts_from_j(j["ts"]),
+            conflicting_commit=serde.commit_from_j(j.get("commit")),
         )
     raise EvidenceError(f"unknown evidence tag {j.get('t')!r}")

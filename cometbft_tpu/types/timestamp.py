@@ -10,9 +10,22 @@ from __future__ import annotations
 
 import time as _time
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 # Seconds of Go's zero time relative to the Unix epoch.
 GO_ZERO_SECONDS = -62135596800
+
+# Pluggable wall-clock source (types/time/time.go Now is similarly a
+# package-level seam): the Byzantine simnet installs a logical clock here
+# so every Timestamp.now() during a simulation is a deterministic function
+# of the schedule, not of the host's wall clock. None = real time.
+_NOW_SOURCE: Optional[Callable[[], "Timestamp"]] = None
+
+
+def set_now_source(fn: Optional[Callable[[], "Timestamp"]]) -> None:
+    """Install (or clear, with None) the process-wide time source."""
+    global _NOW_SOURCE
+    _NOW_SOURCE = fn
 
 
 @dataclass(frozen=True, order=True)
@@ -22,6 +35,8 @@ class Timestamp:
 
     @staticmethod
     def now() -> "Timestamp":
+        if _NOW_SOURCE is not None:
+            return _NOW_SOURCE()
         ns = _time.time_ns()
         return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
 

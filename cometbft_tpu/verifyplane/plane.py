@@ -56,6 +56,10 @@ fp.register("verifyplane.dispatch",
 
 DISPATCH_LOG_MAX = 64       # flush-composition ring kept for tests/ops
 DEFAULT_RESULT_TIMEOUT = 30.0
+# stop()-time leftover drain budget: rows host-verified synchronously
+# before remaining futures fail fast (a few seconds worst-case on the
+# pure-Python path, not minutes)
+STOP_DRAIN_MAX_ROWS = 2048
 
 
 class PlaneError(Exception):
@@ -243,15 +247,34 @@ class VerifyPlane:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        # resolve anything the dispatcher didn't drain so no submitter
-        # ever hangs on a stopped plane
+        # resolve anything the dispatcher didn't drain (dispatcher died,
+        # or the join timed out mid-flush) so no submitter ever hangs on
+        # a stopped plane — and resolve with REAL verdicts via the inline
+        # host path, not an error: callers that already passed submit()
+        # successfully treat the future as authoritative. The host pass
+        # is BUDGETED (pure-Python ed25519 costs ms/row on wheel-less
+        # hosts): past the budget, remaining futures fail fast with
+        # PlaneStopped rather than pinning shutdown for minutes.
         leftovers = []
         with self._cv:
             while self._pending:
                 leftovers.append(self._pending.popleft())
             self._pending_rows = 0
+        budget = STOP_DRAIN_MAX_ROWS
+        settle, fail = [], []
         for sub in leftovers:
-            sub.future._fail(PlaneStopped("verify plane stopped"))
+            if budget >= len(sub.rows):
+                budget -= len(sub.rows)
+                settle.append(sub)
+            else:
+                fail.append(sub)
+        if settle:
+            rows = [r for sub in settle for r in sub.rows]
+            self._settle(settle, _host_verdicts(rows))
+        for sub in fail:
+            sub.future._fail(PlaneStopped(
+                "verify plane stopped with queue over the drain budget"
+            ))
 
     def is_running(self) -> bool:
         return self._running

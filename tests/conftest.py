@@ -25,10 +25,10 @@ if not os.environ.get("CBT_TEST_ON_TPU"):
 
 # Persistent compilation cache: the ed25519 verify kernel takes minutes to
 # compile on CPU; cache it across test runs (cache key includes backend +
-# jax version, so TPU runs are unaffected).
-import jax  # noqa: E402
+# jax version, so TPU runs are unaffected). Shared knobs with
+# __graft_entry__ so the suite and the driver hit ONE cache.
+from cometbft_tpu.libs.jax_cache import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/cbt_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+enable_persistent_compile_cache()

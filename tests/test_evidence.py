@@ -76,6 +76,142 @@ def test_pool_verify_and_lifecycle():
         pool.add_evidence(bad)
 
 
+def _mk_lca(privs, vals, byz_idxs, height, chain_id="ev-chain"):
+    """A verifiable light-client-attack evidence via the simnet actor
+    (forged header + commit signed by the byzantine coalition)."""
+    from cometbft_tpu.simnet.actors import build_light_attack
+
+    return build_light_attack(privs, vals, chain_id, byz_idxs, height,
+                              Timestamp(1_700_000_100, 0))
+
+
+def test_lca_pool_lifecycle():
+    """LightClientAttackEvidence mirrors the duplicate-vote pool cases:
+    add/verify, dedupe, pending, proposed-block check, commit, expiry
+    (ISSUE 3 satellite)."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    pool = EvidencePool("ev-chain", lambda h: vals)
+    ev = _mk_lca(privs, vals, [1, 2], 5)
+    assert pool.add_evidence(ev)
+    assert not pool.add_evidence(ev)  # dedupe
+    assert pool.pending_evidence() == [ev]
+    pool.check_evidence([ev])  # proposed-block check passes
+    pool.mark_committed(6, 1_700_000_110, [ev])
+    assert pool.pending_evidence() == []
+    with pytest.raises(EvidenceError):
+        pool.check_evidence([ev])  # already committed
+
+    # expiry: both age bounds exceeded -> silently refused
+    pool2 = EvidencePool("ev-chain", lambda h: vals,
+                         max_age_blocks=10, max_age_seconds=100)
+    pool2.mark_committed(500, 1_800_000_000, [])
+    old = _mk_lca(privs, vals, [1, 2], 3)
+    assert not pool2.add_evidence(old)
+
+
+def test_lca_verification_rejects_forgeries():
+    """Invalid attacks must not enter the pool: wrong power snapshot,
+    innocent validators named byzantine, sub-1/3 coalitions, and
+    proof-less evidence."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    pool = EvidencePool("ev-chain", lambda h: vals)
+
+    bad_power = _mk_lca(privs, vals, [1, 2], 5)
+    bad_power.total_voting_power = 99
+    with pytest.raises(EvidenceError, match="total power"):
+        pool.add_evidence(bad_power)
+
+    innocent = _mk_lca(privs, vals, [1, 2], 5)
+    innocent.byzantine_validators.append(
+        privs[0].pub_key().address())  # did not sign the fork
+    with pytest.raises(EvidenceError, match="did not sign"):
+        pool.add_evidence(innocent)
+
+    weak = _mk_lca(privs, vals, [1], 5)  # 10/40 < 1/3
+    with pytest.raises(EvidenceError, match="trusting"):
+        pool.add_evidence(weak)
+
+    proofless = _mk_lca(privs, vals, [1, 2], 5)
+    proofless.conflicting_commit = None
+    with pytest.raises(EvidenceError, match="no conflicting commit"):
+        pool.add_evidence(proofless)
+
+    # an INNOCENT validator framed via an appended FORGED commit row:
+    # the named-byzantine check must verify that row's signature itself
+    # (the 1/3-trusting pass early-exits and would never reach it)
+    from cometbft_tpu.types.commit import BLOCK_ID_FLAG_COMMIT, CommitSig
+
+    framed = _mk_lca(privs, vals, [1, 2], 5)
+    victim_addr = privs[0].pub_key().address()
+    vidx, _ = vals.get_by_address(victim_addr)
+    framed.conflicting_commit.signatures[vidx] = CommitSig(
+        BLOCK_ID_FLAG_COMMIT, victim_addr,
+        framed.timestamp, b"\x13" * 64,
+    )
+    framed.byzantine_validators.append(victim_addr)
+    with pytest.raises(EvidenceError, match="FORGED"):
+        pool.add_evidence(framed)
+
+
+def test_lca_attack_level_dedup():
+    """The proof commit is malleable (signer subsets, rows past the 1/3
+    early-exit), so pool dedup keys on the ATTACK
+    (conflicting_header_hash, common_height) — one misbehavior must not
+    re-enter pending/committed under a second proof hash."""
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    pool = EvidencePool("ev-chain", lambda h: vals)
+    ev = _mk_lca(privs, vals, [1, 2], 5)
+    assert pool.add_evidence(ev)
+    # same attack, different (also valid) proof: a 3-signer commit
+    variant = _mk_lca(privs, vals, [1, 2, 3], 5)
+    variant.byzantine_validators = list(ev.byzantine_validators)
+    assert variant.hash() != ev.hash()
+    assert not pool.add_evidence(variant)  # deduped at attack level
+    # after committing one proof, any variant is "already committed"
+    pool.mark_committed(6, 1_700_000_110, [ev])
+    assert pool.size() == 0
+    assert not pool.add_evidence(variant)
+    with pytest.raises(EvidenceError, match="already committed"):
+        pool.check_evidence([variant])
+
+
+def test_lca_serde_roundtrip_keeps_proof():
+    """evidence_to_j/from_j (the gossip + block wire form) must carry
+    the conflicting-commit proof, and the hash must COVER it — a
+    relayer stripping the proof must change the evidence identity (and
+    so the enclosing block's evidence_hash), not produce a same-hash
+    copy that verifies on some nodes and not others."""
+    from cometbft_tpu.types.evidence import (
+        LightClientAttackEvidence,
+        evidence_from_j,
+        evidence_to_j,
+    )
+
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    ev = _mk_lca(privs, vals, [0, 3], 7)
+    back = evidence_from_j(evidence_to_j(ev))
+    assert isinstance(back, LightClientAttackEvidence)
+    assert back.hash() == ev.hash()
+    assert back.conflicting_commit is not None
+    assert back.conflicting_commit.block_id.hash == \
+        ev.conflicting_header_hash
+    # a pool on the other side of the wire verifies the round-tripped form
+    pool = EvidencePool("ev-chain", lambda h: vals)
+    assert pool.add_evidence(back)
+    # identity COVERS the proof: a stripped copy is different evidence
+    stripped = evidence_from_j(
+        {k: v for k, v in evidence_to_j(ev).items() if k != "commit"}
+    )
+    assert stripped.hash() != ev.hash()
+    assert stripped.conflicting_commit is None
+    with pytest.raises(EvidenceError, match="no conflicting commit"):
+        pool.check_evidence([stripped])
+
+
 def test_double_signer_evidence_committed(tmp_path):
     """A byzantine validator's conflicting prevotes are detected by the
     honest nodes, pooled, proposed, and committed into a block whose

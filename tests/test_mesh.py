@@ -9,6 +9,91 @@ from cometbft_tpu.ops import ed25519_kernel as k
 from cometbft_tpu.parallel import mesh as pm
 
 
+"""Both CPU cases are slow-marked: with the jax<0.5 shard_map shim these
+now actually COMPILE on old containers (they used to fail fast on the
+missing jax.shard_map attribute), and an 8-virtual-device compile of the
+full verify graph costs multi-minute wall on a 1-core host. The driver's
+dryrun_multichip covers the sharded paths in the quick gate."""
+
+
+def test_rows_builders_memoized_and_share_verify_program():
+    """ISSUE 3 satellite (round-5 MULTICHIP regression): repeated
+    builder calls return the SAME compiled closure, and every tally
+    width reuses ONE Pallas verify step per mesh — no per-call
+    shard_map rebuilds. Pure cache identity, no compiles."""
+    mesh = pm.make_mesh()
+    assert pm.sharded_verify_tally_rows(mesh, 1) is \
+        pm.sharded_verify_tally_rows(mesh, 1)
+    assert pm.sharded_verify_tally(mesh, 2) is \
+        pm.sharded_verify_tally(mesh, 2)
+    assert pm.sharded_stream_verify(mesh, 4) is \
+        pm.sharded_stream_verify(mesh, 4)
+    # an equivalent mesh (same devices/axes) hits the same entries
+    assert pm.sharded_verify_tally_rows(pm.make_mesh(), 1) is \
+        pm.sharded_verify_tally_rows(mesh, 1)
+    # n_commits=1 and n_commits=16 share the expensive verify program
+    pm.sharded_verify_tally_rows(mesh, 16)
+    assert pm._STEP_CACHE[("rows", pm._mesh_key(mesh), 1)] is not \
+        pm._STEP_CACHE[("rows", pm._mesh_key(mesh), 16)]
+    assert pm._sharded_verify_rows_step(mesh) is \
+        pm._sharded_verify_rows_step(mesh)
+    assert sum(1 for key in pm._STEP_CACHE
+               if key[0] == "pallas-verify") == 1
+
+
+def test_rows_split_plumbing_with_stub_kernel(monkeypatch):
+    """Execute the split verify->tally pipeline over the 8-device mesh
+    with a STUB verify kernel (the real Pallas program costs minutes of
+    interpret compile on CPU): the per-device column extraction, psum,
+    limb carry, and quorum plumbing must tally exactly."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_pallas as kp
+
+    def fake_verify(rows, base):
+        return (rows[kp.C_CID] & 1) == 0  # even commits "verify"
+
+    fake_verify.__wrapped__ = fake_verify
+    monkeypatch.setattr(kp, "_verify_rows", fake_verify)
+    pm._STEP_CACHE.clear()
+    try:
+        mesh = pm.make_mesh()
+        n_dev = len(jax.devices())
+        n_commits = 4
+        n = n_dev * kp.B_TILE
+        keys = [PrivKey.generate(i.to_bytes(4, "big") + b"\x33" * 28)
+                for i in range(8)]
+        pubs = [keys[i % 8].pub_key().data for i in range(n)]
+        msgs = [b"stub-%d" % i for i in range(n)]
+        sigs = [b"\x00" * 64] * n  # content is irrelevant to the stub
+        pb = k.pack_batch(pubs, msgs, sigs, pad_to=n)
+        powers = np.full((n,), 7, np.int64)
+        power5 = k.power_limbs(powers)
+        counted = np.ones((n,), np.bool_)
+        cids = (np.arange(n, dtype=np.int32) % n_commits)
+        thresh = k.threshold_limbs(1, n_commits)
+        rows = kp.pack_rows(pb, power5, counted, cids, thresh)
+        rows[kp.C_THRESH:] = 0
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        step = pm.sharded_verify_tally_rows(mesh, n_commits)
+        rows_d = jax.device_put(
+            rows, NamedSharding(mesh, P(None, mesh.axis_names[0])))
+        valid, tally, quorum = jax.block_until_ready(
+            step(rows_d, kp.base_f32(), thresh))
+        v = np.asarray(valid)[:n]
+        np.testing.assert_array_equal(v, cids % 2 == 0)
+        t = k.tally_to_int(np.asarray(tally))
+        per_commit = n // n_commits * 7
+        assert [int(x) for x in t] == [
+            per_commit if c % 2 == 0 else 0 for c in range(n_commits)
+        ]
+        q = np.asarray(quorum)
+        assert list(q) == [c % 2 == 0 for c in range(n_commits)]
+    finally:
+        pm._STEP_CACHE.clear()  # stub-compiled steps must not leak
+
+
+@pytest.mark.slow
 def test_sharded_matches_single_device():
     assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
     n = 24
@@ -44,6 +129,7 @@ def test_sharded_matches_single_device():
     assert bool(quorum[0]) and bool(quorum[1])
 
 
+@pytest.mark.slow
 def test_sharded_pallas_rows():
     """The flagship Mosaic kernel under shard_map: a 1024-row packed
     batch lane-sharded over the 8-device mesh, per-device Pallas tiles,

@@ -252,16 +252,20 @@ def test_partitioned_node_rejoins(tmp_path):
                 if i != j:
                     n.dial(a)
         assert nodes[0].consensus.wait_for_height(2, timeout=90)
-        # partition node 3: drop all its peers (and everyone drops it)
+        # partition node 3: drop all its peers (and everyone drops it).
+        # persistent entries are cleared FIRST — the redial loop polls
+        # every 0.1s and would otherwise re-establish the link inside
+        # the drop window
         victim = nodes[3]
+        victim.switch.persistent.clear()
+        for n in nodes[:3]:
+            n.switch.persistent.clear()
         for p in list(victim.switch.peers.values()):
             victim.switch.stop_peer_for_error(p, "partition test")
-        victim.switch.persistent.clear()
         for n in nodes[:3]:
             for p in list(n.switch.peers.values()):
                 if p.peer_id == victim.switch.node_key.node_id:
                     n.switch.stop_peer_for_error(p, "partition test")
-            n.switch.persistent.clear()
         h_cut = victim.height()
         # the 3 remaining validators (power 30/40 > 2/3) keep committing
         assert nodes[0].consensus.wait_for_height(h_cut + 3, timeout=90)
@@ -278,6 +282,74 @@ def test_partitioned_node_rejoins(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_redial_backoff_grows_with_jitter():
+    """ISSUE 3 satellite: the persistent-peer redial loop must back off
+    exponentially with jitter — after a partition heals, a fleet
+    redialing in lockstep every 0.5s thundering-herds the accept queue
+    (the simnet's heal schedules exposed this)."""
+    import random
+
+    rng = random.Random(7)
+    # growth: each failure at least doubles (capped), jitter adds 0-50%
+    d1, b1 = Switch._next_backoff(0.0, rng)
+    assert Switch.REDIAL_BASE <= d1 <= Switch.REDIAL_BASE * 1.5
+    assert b1 == Switch.REDIAL_BASE
+    d2, b2 = Switch._next_backoff(b1, rng)
+    assert Switch.REDIAL_BASE * 2 <= d2 <= Switch.REDIAL_BASE * 3
+    assert b2 == Switch.REDIAL_BASE * 2
+    d3, b3 = Switch._next_backoff(Switch.REDIAL_MAX * 2, rng)
+    assert Switch.REDIAL_MAX <= d3 <= Switch.REDIAL_MAX * 1.5  # capped
+    assert b3 == Switch.REDIAL_MAX
+    # jitter decorrelates two dialers with identical failure history
+    draws = {round(Switch._next_backoff(1.0, random.Random(s))[0], 6)
+             for s in range(8)}
+    assert len(draws) > 1, "no jitter: herd redials stay in lockstep"
+
+
+def test_redial_backoff_paces_attempts_then_recovers():
+    """With dials failing, redial attempts are PACED (bounded count in a
+    window) instead of hammering every loop tick; once the fault clears
+    the backed-off redial still reconnects."""
+    from cometbft_tpu.libs import failpoints as fp
+
+    from cometbft_tpu.p2p.switch import Reactor
+
+    class Chan(Reactor):
+        def __init__(self):
+            super().__init__("CHAN")
+
+        def channel_descriptors(self):
+            return [ChannelDescriptor(0x71)]
+
+    fp.reset()
+    ka, kb = NodeKey(PrivKey.generate(b"\x2a" * 32)), \
+        NodeKey(PrivKey.generate(b"\x2b" * 32))
+    sa, sb = Switch(ka, "net-bk"), Switch(kb, "net-bk")
+    sa.add_reactor(Chan())
+    sb.add_reactor(Chan())
+    addr_a = sa.listen()
+    sa.start()
+    try:
+        fp.arm("p2p.dial", "raise")
+        sb.persistent[addr_a.node_id] = addr_a  # redial loop owns it
+        sb.start()
+        time.sleep(2.0)
+        fails = fp.registry().stats("p2p.dial")["fires"]
+        # exponential backoff: ~0 + 0.25j + 0.5j + 1.0j... -> <= 5
+        # attempts in 2s (the old fixed 0.5s loop made 4+ and NEVER
+        # stretched further)
+        assert 1 <= fails <= 5, f"unpaced redials: {fails} in 2s"
+        fp.disarm("p2p.dial")
+        deadline = time.time() + 10
+        while sa.num_peers() < 1 or sb.num_peers() < 1:
+            assert time.time() < deadline, \
+                "backed-off redial never reconnected"
+            time.sleep(0.02)
+    finally:
+        fp.reset()
+        sa.stop(); sb.stop()
 
 
 def test_dial_and_handshake_failpoints_recover():

@@ -1,0 +1,204 @@
+"""Byzantine simnet: deterministic adversarial scenarios over real
+node/consensus stacks (cometbft_tpu/simnet/).
+
+Tier-1 scenarios are budgeted small (<= a few simulated heights, no
+kernel compiles — everything is host-path crypto); the long randomized
+schedules live in tools/simnet_fuzz.py. File named test_simnet.py so it
+lands late in the alphabetical tier-1 order (ROADMAP timeout note).
+
+Every scenario asserts safety (no conflicting commits) and, where the
+schedule permits a quorum, liveness after heal. A failing assertion
+raises SimnetFailure carrying the exact seed + schedule replay blob.
+"""
+import json
+
+import pytest
+
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.simnet import (
+    Simnet,
+    SimnetFailure,
+    schedule_to_json,
+    validate_schedule,
+)
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+)
+
+pytestmark = pytest.mark.simnet
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+FAULTY_SCHEDULE = [
+    {"at": 0.05, "op": "link", "drop": 0.08, "delay": 0.02,
+     "jitter": 0.01, "dup": 0.05, "reorder": 0.05},
+    {"at": 0.2, "op": "partition", "groups": [[0, 1, 2], [3]]},
+    {"at": 0.3, "op": "tx", "node": 0, "data": b"sim=net".hex()},
+    {"at": 1.0, "op": "heal"},
+]
+
+
+def test_quick_consensus_no_faults(tmp_path):
+    """Baseline: 4 simulated validators reach height 3 and agree."""
+    with Simnet(4, seed=1, basedir=str(tmp_path)) as sim:
+        assert sim.run([], until_height=3, max_time=60.0)
+        assert all(n.height() >= 3 for n in sim.net.nodes)
+        sim.assert_safety()
+        # all four committed the same block 2
+        hashes = sim.commit_hashes()
+        assert len({h[2] for h in hashes}) == 1
+
+
+def test_determinism_same_seed_same_chain(tmp_path):
+    """ISSUE 3 acceptance: the same (seed, schedule) twice yields
+    identical commit hashes at every height on every node — drops,
+    duplication, reordering, and a partition included."""
+
+    def run_once(tag):
+        with Simnet(4, seed=77, basedir=str(tmp_path / tag)) as sim:
+            assert sim.run(FAULTY_SCHEDULE, until_height=4,
+                           max_time=120.0)
+            sim.assert_safety()
+            return sim.commit_hashes()
+
+    assert run_once("a") == run_once("b")
+
+
+def test_partition_minority_stalls_then_catches_up(tmp_path):
+    """A partitioned validator cannot commit (safety) while the 3/4
+    majority keeps going; after heal the catch-up pushes restore it."""
+    with Simnet(4, seed=5, basedir=str(tmp_path)) as sim:
+        sim.run([], until_height=2, max_time=60.0)
+        cut = sim.net.now
+        sim.run([{"at": cut, "op": "partition",
+                  "groups": [[0, 1, 2], [3]]}], max_time=0.1)
+        victim = sim.net.nodes[3]
+        h_cut = victim.height()
+        majority_target = max(n.height() for n in sim.net.nodes) + 2
+        assert sim.run(
+            [],
+            until=lambda: all(sim.net.nodes[i].height()
+                              >= majority_target for i in (0, 1, 2)),
+            max_time=60.0,
+        )
+        assert victim.height() <= h_cut + 1  # at most one in-flight commit
+        sim.run([{"at": sim.net.now, "op": "heal"}], max_time=0.1)
+        assert sim.run(
+            [], until=lambda: victim.height() >= majority_target,
+            max_time=60.0,
+        ), f"victim stuck at {victim.height()}"
+        sim.assert_safety()
+
+
+def test_equivocator_lands_in_committed_evidence(tmp_path):
+    """ISSUE 3 acceptance: a double-signing validator's conflicting
+    prevotes surface as DuplicateVoteEvidence (height_vote_set conflict
+    detection), flow through the pool, and end committed in a block on
+    every node — chain stays safe and live throughout."""
+    with Simnet(4, seed=11, basedir=str(tmp_path)) as sim:
+        sim.run([{"at": 0.12, "op": "equivocate", "node": 3, "votes": 2}],
+                until_height=2, max_time=60.0)
+        ev = sim.assert_evidence_committed(
+            predicate=lambda e: isinstance(e, DuplicateVoteEvidence)
+        )
+        assert ev.vote_a.validator_address == \
+            sim.net.privs[3].pub_key().address()
+        sim.assert_safety()
+        sim.assert_liveness(min_new_heights=2, max_time=30.0)
+
+
+def test_garbage_signer_does_not_poison_verify_plane(tmp_path):
+    """ISSUE 3 acceptance: forged signatures coalesce through a RUNNING
+    verify plane with honest votes; verdicts reject them, consensus
+    proceeds, and the circuit breaker stays closed (no permanent host
+    fallback) — an invalid signature is a verdict, not a device
+    fault."""
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    try:
+        with Simnet(4, seed=22, basedir=str(tmp_path)) as sim:
+            assert sim.run(
+                [{"at": 0.1, "op": "garbage", "node": 1, "votes": 4}],
+                until_height=4, max_time=60.0,
+            )
+            sim.assert_safety()
+        stats = plane.stats()
+        assert stats["breaker_state"] == "closed", stats
+        assert plane.rows_verified > 0  # votes really rode the plane
+    finally:
+        set_global_plane(None)
+        plane.stop()
+
+
+def test_light_client_attack_evidence_committed(tmp_path):
+    """A >=1/3 coalition's forged header reaches one honest node as
+    LightClientAttackEvidence (with its conflicting-commit proof),
+    passes verify_light_client_attack, gossips, and is committed."""
+    with Simnet(4, seed=12, basedir=str(tmp_path)) as sim:
+        sim.run([], until_height=2, max_time=60.0)
+        sim.run([{"at": sim.net.now + 0.05, "op": "light_attack",
+                  "byz": [2, 3], "target": 0, "height": 1}],
+                max_time=1.0)
+        ev = sim.assert_evidence_committed(
+            predicate=lambda e: isinstance(e, LightClientAttackEvidence)
+        )
+        assert len(ev.byzantine_validators) == 2
+        assert ev.common_height == 1
+        sim.assert_safety()
+
+
+def test_failpoint_crash_and_wal_recovery(tmp_path):
+    """A consensus.wal.post_vote crash failpoint armed on ONE node's
+    private registry halts exactly that node; a later restart rebuilds
+    it over the same home dir (WAL catchup replay + handshake replay)
+    and it catches back up to the tip."""
+    with Simnet(4, seed=21, basedir=str(tmp_path)) as sim:
+        sim.run([
+            {"at": 0.15, "op": "failpoint", "node": 2,
+             "spec": "consensus.wal.post_vote=crash*1"},
+            {"at": 2.0, "op": "restart", "node": 2},
+        ], until_height=4, max_time=120.0)
+        n2 = sim.net.nodes[2]
+        # the crash fired on node 2's registry and nowhere else
+        assert n2.registry.stats("consensus.wal.post_vote")["fires"] == 1
+        for i in (0, 1, 3):
+            st = sim.net.nodes[i].registry.stats(
+                "consensus.wal.post_vote")
+            assert st is None or st["fires"] == 0
+        tip = max(n.height() for n in sim.net.nodes if n.alive)
+        assert sim.run(
+            [], until=lambda: n2.alive and n2.height() >= tip,
+            max_time=60.0,
+        ), (n2.alive, n2.height(), tip)
+        assert n2.restarts == 1
+        sim.assert_safety()
+
+
+def test_failure_carries_replay_blob(tmp_path):
+    """Every simnet assertion failure must print the reproducing seed +
+    schedule: kill beyond quorum, then ask for liveness."""
+    sched = [{"at": 0.2, "op": "kill", "node": 2},
+             {"at": 0.25, "op": "kill", "node": 3}]
+    with Simnet(4, seed=9, basedir=str(tmp_path)) as sim:
+        sim.run(sched, max_time=0.5)
+        with pytest.raises(SimnetFailure) as ei:
+            sim.assert_liveness(min_new_heights=1, max_time=5.0)
+        msg = str(ei.value)
+        assert "replay:" in msg
+        blob = json.loads(msg.split("replay:", 1)[1])
+        assert blob["seed"] == 9
+        assert blob["schedule"] == sched
+        # the blob round-trips through the schedule validator
+        validate_schedule(blob["schedule"], 4)
+        assert schedule_to_json(9, sched) == json.dumps(
+            blob, sort_keys=True)

@@ -195,6 +195,71 @@ def test_stop_drains_pending_futures():
         p.submit(pubs[0], msgs[0], sigs[0])
 
 
+def test_stop_under_load_resolves_every_future():
+    """ISSUE 3 satellite: stop() racing a crowd of submitters (queued +
+    in-flight + backpressure-blocked) must leave NO future unresolved —
+    every submitter either gets verdicts or a PlaneError from submit(),
+    within a bounded wait. A mid-flush delay failpoint forces the
+    in-flight case."""
+    p = VerifyPlane(window_ms=1.0, max_batch=64, max_queue=16)
+    p.start()
+    pubs, msgs, sigs, exp = make_rows(12)
+    fp.arm("verifyplane.dispatch", "delay", arg=0.5, count=1)
+    outcomes = {}
+    start = threading.Barrier(5)
+
+    def worker(k):
+        start.wait()
+        for i in range(12):
+            try:
+                fut = p.submit(pubs[i], msgs[i], sigs[i])
+            except PlaneError:
+                outcomes[(k, i)] = "refused"
+                continue
+            try:
+                outcomes[(k, i)] = fut.result(10.0)[0]
+            except PlaneError:
+                outcomes[(k, i)] = "failed"
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    start.wait()  # all four submitters racing...
+    time.sleep(0.05)
+    p.stop()      # ...and the plane stops under them
+    for t in threads:
+        t.join(timeout=20.0)
+    assert not any(t.is_alive() for t in threads), "submitter hung"
+    # every accepted submission RESOLVED (verdict or error — no hang),
+    # and every verdict that came back matches the oracle
+    for (k, i), got in outcomes.items():
+        if isinstance(got, bool):
+            assert got == exp[i], (k, i)
+    assert len(outcomes) == 4 * 12
+
+
+def test_stop_leftovers_resolve_with_host_verdicts():
+    """The leftovers path (plane.py stop()): submissions the dispatcher
+    never drained — dead dispatcher simulated by a running plane with no
+    thread — resolve via the inline host path with REAL verdicts, and
+    counted group tallies still land."""
+    p = VerifyPlane(window_ms=1.0)
+    # a "running" plane whose dispatcher never existed: everything
+    # submitted stays queued — exactly the state stop() must clean up
+    p._running = True
+    pubs, msgs, sigs, exp = make_rows(6)
+    g = QuorumGroup(threshold=15)
+    futs = [p.submit(pubs[i], msgs[i], sigs[i], power=10, group=g,
+                     counted=True) for i in range(6)]
+    assert not any(f.done() for f in futs)
+    p.stop()
+    for i, f in enumerate(futs):
+        assert f.result(5.0) == (exp[i],)
+    assert g.tally == 10 * sum(exp)
+    assert g.quorum_reached == (g.tally >= 15)
+
+
 # -- fused quorum tally ----------------------------------------------------
 
 
