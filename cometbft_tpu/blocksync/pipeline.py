@@ -69,6 +69,8 @@ class StreamVerifier:
 
     def __init__(self, max_sigs: int = 65536, use_pallas: bool = False,
                  min_device_sigs: int = 129):
+        from cometbft_tpu.libs.staging import StagingPool
+
         self.max_sigs = max_sigs
         self.use_pallas = use_pallas
         self._vs_cache = {}
@@ -76,8 +78,26 @@ class StreamVerifier:
         # loop (dispatch + compile economics — the shouldBatchVerify gate,
         # types/validation.go:13-17, applied to the streaming path)
         self.min_device_sigs = min_device_sigs
+        # private staging pool, 3 deep: up to 2 chunks fly while a 3rd
+        # packs (the double-buffer window below), so rotation can never
+        # hand back a buffer whose upload is still the newest dispatch
+        self._staging = StagingPool(slots=3)
 
     # -- packing -----------------------------------------------------------
+
+    @staticmethod
+    def _template_msgs(jobs, job_idxs):
+        """No-native fallback: vectorized template patching per commit
+        (Commit.sign_bytes_rows via validation's toggle) — byte-equal
+        to the legacy per-row vote_sign_bytes loop, shared by both
+        pack paths."""
+        from cometbft_tpu.types import validation as tv
+
+        msgs = []
+        for j, idxs in job_idxs:
+            job = jobs[j][1]
+            msgs += tv._commit_msgs(job.chain_id, job.commit, idxs)
+        return msgs
 
     def _valset_arrays(self, vs):
         """(pub_bytes_list, power_list, all_32B) per ValidatorSet,
@@ -86,8 +106,10 @@ class StreamVerifier:
         cached = self._vs_cache.get(id(vs))
         if cached is not None and cached[3] is vs:
             return cached[:3]
-        keys = [v.pub_key.data for v in vs.validators]
-        powers = [v.voting_power for v in vs.validators]
+        # tuples, not lists: immutable key columns hit the identity-
+        # memoized content key in ed25519_cached.table_for_pubs
+        keys = tuple(v.pub_key.data for v in vs.validators)
+        powers = tuple(v.voting_power for v in vs.validators)
         keys_ok = all(len(k) == 32 for k in keys)
         if len(self._vs_cache) > 8:
             self._vs_cache.clear()
@@ -109,7 +131,9 @@ class StreamVerifier:
             return None
         from cometbft_tpu.ops import ed25519_cached as ec
 
-        return ec.table_for_pubs(keys, vpowers)
+        # device-resident per-valset cache: the steady sync stream hits
+        # the identity memo and never re-hashes (or re-uploads) the set
+        return ec.table_for_valset(vs0)
 
     def _pack_chunk_cached(self, jobs, table) -> Optional[_Chunk]:
         """Strided pack for the cached-table kernel: commit c occupies
@@ -135,6 +159,7 @@ class StreamVerifier:
         row_idx: List[int] = []
         row_pos: List[int] = []
         row_ts: List[tuple] = []
+        job_idxs: List[tuple] = []  # (j, idxs) for the template fallback
         keys, _, _ = self._valset_arrays(jobs[0][1].vals)
         nvals = len(keys)
         for j, (_, job) in enumerate(jobs):
@@ -150,6 +175,7 @@ class StreamVerifier:
             row_job += [j] * len(idxs)
             row_idx += idxs
             row_pos += [j * M + i for i in idxs]
+            job_idxs.append((j, idxs))
         if not pubs:
             return None
         n = len(pubs)
@@ -175,27 +201,27 @@ class StreamVerifier:
         if packed is not None:
             _, _, ry_d, rsign_d, sdig_d, hdig_d, pre_d = packed
         else:
-            msgs = [
-                jobs[j][1].commit.vote_sign_bytes(jobs[j][1].chain_id, idx)
-                for j, idx in zip(row_job, row_idx)
-            ]
+            msgs = self._template_msgs(jobs, job_idxs)
             pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
             ry_d, rsign_d = pbd.ry, pbd.rsign
             sdig_d, hdig_d, pre_d = pbd.sdig, pbd.hdig, pbd.precheck
         pos = np.asarray(row_pos, np.int64)
-        ry = np.zeros((B, ry_d.shape[1]), ry_d.dtype)
+        # pinned staging: chunk arrays rotate through the verifier's
+        # persistent pool so packing chunk k+1 reuses chunk k-2's memory
+        pool = self._staging
+        ry = pool.get("chunk.ry", (B, ry_d.shape[1]), ry_d.dtype)
         ry[pos] = ry_d[:n]
-        rsign = np.zeros(B, np.int32)
+        rsign = pool.get("chunk.rsign", (B,), np.int32)
         rsign[pos] = np.asarray(rsign_d[:n], np.int32)
-        sdig = np.zeros((B, sdig_d.shape[1]), sdig_d.dtype)
+        sdig = pool.get("chunk.sdig", (B, sdig_d.shape[1]), sdig_d.dtype)
         sdig[pos] = sdig_d[:n]
-        hdig = np.zeros((B, hdig_d.shape[1]), hdig_d.dtype)
+        hdig = pool.get("chunk.hdig", (B, hdig_d.shape[1]), hdig_d.dtype)
         hdig[pos] = hdig_d[:n]
-        precheck = np.zeros(B, np.bool_)
+        precheck = pool.get("chunk.precheck", (B,), np.bool_)
         precheck[pos] = np.asarray(pre_d[:n], np.bool_)
-        counted = np.zeros(B, np.bool_)
+        counted = pool.get("chunk.counted", (B,), np.bool_)
         counted[pos] = True
-        commit_ids = np.zeros(B, np.int32)
+        commit_ids = pool.get("chunk.cid", (B,), np.int32)
         for j in range(cap):
             commit_ids[j * M:(j + 1) * M] = j
         thresh = np.zeros((cap, ek.TALLY_LIMBS), np.int32)
@@ -205,7 +231,10 @@ class StreamVerifier:
                 job.vals.total_voting_power() * 2 // 3
             )[0]
         pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
-        rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh)
+        out = pool.get("chunk.rows", ec.packed_rows_shape(B, cap),
+                       np.int32)
+        rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh,
+                                   out=out)
         pending = ec.verify_tally_rows_cached(rows, table, cap)
         return _Chunk(list(jobs), np.asarray(row_job),
                       np.asarray(row_idx), pending, row_pos=pos)
@@ -221,6 +250,7 @@ class StreamVerifier:
         row_idx: List[int] = []
         powers: List[int] = []
         row_ts: List[tuple] = []
+        job_idxs: List[tuple] = []  # (j, idxs) for the template fallback
         well_formed = True
         native_possible = native.available()
         for j, (_, job) in enumerate(jobs):
@@ -244,6 +274,7 @@ class StreamVerifier:
             row_job += [j] * len(idxs)
             row_idx += idxs
             powers += [vpowers[i] for i in idxs]
+            job_idxs.append((j, idxs))
             if not keys_ok or any(len(css[i].signature) != 64
                                   for i in idxs):
                 well_formed = False  # numpy path screens bad rows
@@ -279,10 +310,7 @@ class StreamVerifier:
         if packed is not None:
             pb = ek.PackedBatch(n, pad, *packed)
         else:
-            msgs = [
-                jobs[j][1].commit.vote_sign_bytes(jobs[j][1].chain_id, idx)
-                for j, idx in zip(row_job, row_idx)
-            ]
+            msgs = self._template_msgs(jobs, job_idxs)
             pb = ek.pack_batch(pubs, msgs, sigs, pad_to=pad)
         power5 = np.zeros((pad, ek.POWER_LIMBS), np.int32)
         power5[:n] = ek.power_limbs(np.asarray(powers, np.int64))

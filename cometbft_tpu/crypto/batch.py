@@ -41,6 +41,7 @@ from cometbft_tpu.crypto.keys import (
     PubKey,
 )
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs.staging import StagingPool
 
 _log = logging.getLogger(__name__)
 
@@ -137,6 +138,19 @@ _DEVICE_BREAKER = CircuitBreaker(name="verify-device")
 
 def device_breaker() -> CircuitBreaker:
     return _DEVICE_BREAKER
+
+
+# One staging pool for THE device, mirroring the breaker: every caller
+# that packs rows for upload (verify plane flushes, blocksync chunks,
+# the bench) rotates through the same two persistent host buffers per
+# bucket shape, so the dispatcher can pack flush k+1 while the device
+# still verifies flush k (libs/staging.py). Device-resident caches
+# (valset/window tables) never ride this pool — donation-safe.
+_STAGING = StagingPool(slots=2)
+
+
+def staging_pool() -> StagingPool:
+    return _STAGING
 
 
 def configure_breaker(failure_threshold: int, cooldown: float) -> None:

@@ -223,6 +223,18 @@ class NodeMetrics:
         self.plane_padding_waste = r.counter(
             "verifyplane", "padding_waste_total",
             "Dead rows added padding flushes to compiled bucket shapes")
+        self.plane_pack_seconds = r.histogram(
+            "verifyplane", "pack_seconds",
+            "Host-side staging time per verify-plane flush (template "
+            "packing + row scatter, before device dispatch)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1),
+        )
+        self.plane_h2d_bytes = r.counter(
+            "verifyplane", "h2d_bytes_total",
+            "Bytes of packed signature rows staged host-to-device by "
+            "verify-plane flushes (valset tables are device-resident "
+            "and excluded)")
         # mempool
         self.mempool_size = r.gauge("mempool", "size",
                                     "Pending transactions")

@@ -1,0 +1,84 @@
+"""Pinned double-buffered host staging for device uploads.
+
+Every verify flush used to allocate fresh numpy arrays (np.zeros per
+bucket shape per flush) for the packed signature rows. Under streaming
+load that is pure allocator churn on the hot path, and it defeats
+overlap: the dispatcher cannot pack flush k+1 into the same memory the
+device is still copying for flush k. This pool keeps `slots` (default
+2) persistent arrays per (name, shape, dtype) and rotates them — the
+classic double buffer: while the device consumes buffer A of a shape,
+the host packs into buffer B, and by the time A comes around again its
+H2D copy has long completed (JAX transfers the argument before the
+dispatch call returns).
+
+The arrays are ordinary page-locked-by-reuse host memory (numpy cannot
+ask for cudaHostAlloc-style pinning; steady reuse keeps the pages hot
+and resident, which is what the tunnel transport actually benefits
+from). Donation-safety: the pool only ever hands out HOST buffers —
+device-resident caches (valset tables, window tables) are never staged
+through it, so enabling jit donation on the rows argument can never
+free a cached table buffer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class StagingPool:
+    """Rotating preallocated host arrays, `slots` deep per shape."""
+
+    def __init__(self, slots: int = 2):
+        self.slots = max(1, int(slots))
+        self._lock = threading.Lock()
+        self._bufs: Dict[tuple, list] = {}
+        self._next: Dict[tuple, int] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, name: str, shape: Tuple[int, ...], dtype,
+            zero: bool = True) -> np.ndarray:
+        """The next staging buffer for (name, shape, dtype); zeroed by
+        default. Callers must be done writing a buffer before asking
+        for `slots` more of the same key (the rotation contract)."""
+        key = (name, tuple(int(s) for s in shape), np.dtype(dtype).str)
+        with self._lock:
+            bufs = self._bufs.get(key)
+            if bufs is None:
+                bufs = self._bufs[key] = []
+            if len(bufs) < self.slots:
+                buf = np.zeros(key[1], dtype)
+                bufs.append(buf)
+                self._next[key] = len(bufs) % self.slots
+                self.misses += 1
+                return buf
+            i = self._next[key]
+            self._next[key] = (i + 1) % self.slots
+            buf = bufs[i]
+            self.hits += 1
+        if zero:
+            buf.fill(0)
+        return buf
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(b.nbytes for bufs in self._bufs.values()
+                       for b in bufs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "shapes": len(self._bufs),
+                "resident_bytes": sum(
+                    b.nbytes for bufs in self._bufs.values() for b in bufs
+                ),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bufs.clear()
+            self._next.clear()

@@ -398,6 +398,16 @@ _TABLE_CACHE_MAX = 8
 _TABLE_LOCK = threading.Lock()
 MAX_INCREMENTAL = 64  # fall back to full rebuild above this delta
 
+# steady-state observability + the zero-copy hot path's regression
+# guard: a healthy consensus stream should be ~all hits
+_TABLE_STATS = {"hits": 0, "misses": 0, "key_memo_hits": 0,
+                "valset_hits": 0, "valset_misses": 0}
+
+
+def table_cache_stats() -> dict:
+    with _TABLE_LOCK:
+        return dict(_TABLE_STATS)
+
 
 def _cache_key(pub_bytes: Sequence[bytes], powers) -> bytes:
     h = hashlib.sha256()
@@ -413,14 +423,45 @@ def _cache_key(pub_bytes: Sequence[bytes], powers) -> bytes:
     return h.digest() + len(pub_bytes).to_bytes(4, "big")
 
 
+# Identity memo over the content key: _cache_key walks every pubkey in
+# Python (~ms at 10k validators), which used to run on EVERY flush.
+# Callers that present a stable immutable key list (QuorumGroup's
+# valset_pubs tuple, StreamVerifier's per-valset columns) pay it once.
+# Entries pin the tuples themselves, so an id() can never alias a
+# collected object.
+_KEY_MEMO: "OrderedDict[int, tuple]" = OrderedDict()
+_KEY_MEMO_MAX = 16
+
+
+def _memo_cache_key(pub_bytes, powers) -> bytes:
+    if type(pub_bytes) is not tuple or not (
+        powers is None or type(powers) is tuple
+    ):
+        return _cache_key(pub_bytes, powers)  # mutable: never memoize
+    with _TABLE_LOCK:
+        ent = _KEY_MEMO.get(id(pub_bytes))
+        if ent is not None and ent[0] is pub_bytes and ent[1] is powers:
+            _KEY_MEMO.move_to_end(id(pub_bytes))
+            _TABLE_STATS["key_memo_hits"] += 1
+            return ent[2]
+    key = _cache_key(pub_bytes, powers)
+    with _TABLE_LOCK:
+        _KEY_MEMO[id(pub_bytes)] = (pub_bytes, powers, key)
+        while len(_KEY_MEMO) > _KEY_MEMO_MAX:
+            _KEY_MEMO.popitem(last=False)
+    return key
+
+
 def table_for_pubs(pub_bytes: Sequence[bytes],
                    powers=None) -> ValsetTable:
-    key = _cache_key(pub_bytes, powers)
+    key = _memo_cache_key(pub_bytes, powers)
     with _TABLE_LOCK:
         t = _TABLE_CACHE.get(key)
         if t is not None:
             _TABLE_CACHE.move_to_end(key)
+            _TABLE_STATS["hits"] += 1
             return t
+        _TABLE_STATS["misses"] += 1
         # near-miss scan: same padded size, few changed slots -> update
         # the cached table incrementally (valset churn between epochs).
         # The delta compares FULL pubkey bytes — a digest here would
@@ -461,6 +502,40 @@ def table_for_pubs(pub_bytes: Sequence[bytes],
         _TABLE_CACHE[key] = t
         while len(_TABLE_CACHE) > _TABLE_CACHE_MAX:
             _TABLE_CACHE.popitem(last=False)
+    return t
+
+
+# Device-resident per-valset front cache: consensus and blocksync hold
+# ONE ValidatorSet object per height window, so the (pubs, powers)
+# column extraction + content-key digest hoist out of the per-flush
+# path entirely — steady-state verification never re-reads the valset,
+# let alone re-uploads it. Entries pin the set AND its validators list:
+# update_with_change_set replaces the list wholesale, so a mutated set
+# can never serve a stale table (the priority-only mutations of
+# proposer rotation don't touch keys or powers).
+_VALSET_MEMO: "OrderedDict[int, tuple]" = OrderedDict()
+_VALSET_MEMO_MAX = 8
+
+
+def table_for_valset(vals) -> ValsetTable:
+    """The device window table for a types.validator.ValidatorSet,
+    memoized by set identity (mesh.py-style) over the content-keyed
+    LRU. The fast path costs two dict probes, no per-validator work."""
+    with _TABLE_LOCK:
+        ent = _VALSET_MEMO.get(id(vals))
+        if ent is not None and ent[0] is vals \
+                and ent[1] is vals.validators:
+            _VALSET_MEMO.move_to_end(id(vals))
+            _TABLE_STATS["valset_hits"] += 1
+            return ent[2]
+    pubs = tuple(v.pub_key.data for v in vals.validators)
+    powers = tuple(v.voting_power for v in vals.validators)
+    t = table_for_pubs(pubs, powers)
+    with _TABLE_LOCK:
+        _TABLE_STATS["valset_misses"] += 1
+        _VALSET_MEMO[id(vals)] = (vals, vals.validators, t)
+        while len(_VALSET_MEMO) > _VALSET_MEMO_MAX:
+            _VALSET_MEMO.popitem(last=False)
     return t
 
 
@@ -677,21 +752,40 @@ def _verify_tally_cached(rows, tab, ok, power5, base, n_commits: int):
 # --------------------------------------------------------------------------
 
 
+def packed_rows_shape(B: int, n_commits: int = 1) -> tuple:
+    """Shape of the packed (R, B) array pack_rows_cached builds for a
+    B-row flush carrying n_commits thresholds — the ONE home of the
+    threshold-row layout math. Staging buffers handed to
+    pack_rows_cached(out=...) MUST be sized through this, or the
+    mismatch is silently ignored and the pooling benefit lost."""
+    t_rows = max(1, -(-(n_commits * ek.TALLY_LIMBS) // B))
+    return (V_THRESH + t_rows, B)
+
+
 def pack_rows_cached(pb, counted=None, commit_ids=None,
-                     thresh=None) -> np.ndarray:
+                     thresh=None, out=None) -> np.ndarray:
     """PackedBatch -> one compact (R, B) int32 array for the cached path.
 
     Same single-transfer philosophy as ed25519_pallas.pack_rows, minus
     the 10 pubkey rows (the device table replaces them), any index row
     (row b's validator is b mod M by construction — callers MUST lay
     commits out in valset order padded to the table stride), and the
-    power rows (valset data, carried by the table)."""
+    power rows (valset data, carried by the table).
+
+    `out` (optional) is a preallocated zeroed (R, B) int32 staging
+    buffer — the pinned double-buffer path (libs/staging.py) — so a
+    streaming dispatcher packs flush k+1 while the device copies/
+    verifies flush k without allocator churn."""
     B = pb.ry.shape[0]
     if thresh is None:
         thresh = np.zeros((1, ek.TALLY_LIMBS), np.int32)
     tvals = np.asarray(thresh, np.int32).reshape(-1)
     t_rows = max(1, -(-tvals.size // B))
-    rows = np.zeros((V_THRESH + t_rows, B), np.int32)
+    if out is not None and out.shape == (V_THRESH + t_rows, B) \
+            and out.dtype == np.int32:
+        rows = out
+    else:
+        rows = np.zeros((V_THRESH + t_rows, B), np.int32)
     ry = np.asarray(pb.ry, np.int32)
     rows[V_RY:V_RY + 10] = (ry[:, :10] | (ry[:, 10:] << 13)).T
     s8 = (pb.sdig[:, 0::2] + 16 * pb.sdig[:, 1::2]).astype(np.int32)
@@ -717,7 +811,16 @@ def pack_rows_cached(pb, counted=None, commit_ids=None,
 
 
 def verify_tally_rows_cached(rows, table: ValsetTable, n_commits: int):
-    """Fused verify+tally from one packed (R, B) array."""
+    """Fused verify+tally from one packed (R, B) array.
+
+    Buffer-lifetime note (README "Zero-copy hot path"): the per-flush
+    rows buffer is dead once the kernel has consumed it — XLA buffer
+    donation was evaluated here but does nothing for this signature
+    (no output aval matches the (R, B) rows input, so XLA cannot alias
+    it and merely warns), so the staging turnover is handled host-side
+    by the pool rotation instead. The valset table / ok / power5 /
+    base comb arguments are long-lived device-resident caches and must
+    NEVER be donated or staged through the rotating pool."""
     return _verify_tally_cached(rows, table.tab, table.ok,
                                 table.power5, base60_dev(), n_commits)
 

@@ -50,6 +50,25 @@ def make_mesh(devices=None, axis: str = "batch") -> Mesh:
 # specialization within a step.
 _STEP_CACHE: dict = {}
 
+# Memoization regression guard (the round-5 MULTICHIP timeout was
+# per-call shard_map rebuilds): every builder counts its probe, so
+# tests — and the bench's multichip smoke — can assert steady-state
+# calls HIT instead of silently re-tracing.
+_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def cache_stats() -> dict:
+    return dict(_CACHE_STATS)
+
+
+def _cache_get(key):
+    fn = _STEP_CACHE.get(key)
+    if fn is not None:
+        _CACHE_STATS["hits"] += 1
+    else:
+        _CACHE_STATS["misses"] += 1
+    return fn
+
 
 def _mesh_key(mesh: Mesh):
     return (tuple(mesh.axis_names), tuple(mesh.devices.flat))
@@ -72,7 +91,7 @@ def sharded_verify_tally(mesh: Mesh, n_commits: int):
     replicated. Memoized per (mesh, n_commits).
     """
     key = ("xla", _mesh_key(mesh), int(n_commits))
-    cached = _STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
     axis = mesh.axis_names[0]
@@ -106,7 +125,7 @@ def _sharded_verify_rows_step(mesh: Mesh):
     compiled program — the round-5 multichip regression was exactly this
     program compiling once per (call, n_commits)."""
     key = ("pallas-verify", _mesh_key(mesh))
-    cached = _STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
     from cometbft_tpu.ops import ed25519_pallas as kp
@@ -141,7 +160,7 @@ def _sharded_tally_step(mesh: Mesh, n_commits: int):
     """The CHEAP half: per-device tally einsum + psum + quorum. A fresh
     trace per n_commits costs seconds, not the Pallas kernel's minutes."""
     key = ("pallas-tally", _mesh_key(mesh), int(n_commits))
-    cached = _STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
     axis = mesh.axis_names[0]
@@ -180,7 +199,7 @@ def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
     the round-5 multichip regression — reuse the compiled closures
     instead of re-tracing."""
     key = ("rows", _mesh_key(mesh), int(n_commits))
-    cached = _STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
     verify = _sharded_verify_rows_step(mesh)
@@ -242,7 +261,7 @@ def sharded_stream_verify(mesh: Mesh, n_commits: int):
     from cometbft_tpu.ops import ed25519_cached as ec
 
     key = ("stream", _mesh_key(mesh), int(n_commits))
-    cached = _STEP_CACHE.get(key)
+    cached = _cache_get(key)
     if cached is not None:
         return cached
     axis = mesh.axis_names[0]

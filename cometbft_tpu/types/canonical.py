@@ -9,7 +9,9 @@ TestVoteSignBytesTestVectors — replicated in tests/test_canonical.py.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
+
+import numpy as np
 
 from cometbft_tpu.libs import protoenc as pe
 from cometbft_tpu.types.block_id import BlockID
@@ -84,6 +86,152 @@ class CanonicalVoteEncoder:
         body = (self._pre + pe.f_msg(5, pe.timestamp(ts.seconds, ts.nanos))
                 + self._suf)
         return pe.delimited(body)
+
+
+# --------------------------------------------------------------------------
+# Vectorized template packing (the zero-copy verify hot path)
+# --------------------------------------------------------------------------
+#
+# Within one commit, every validator signs the SAME CanonicalVote except
+# for the timestamp (types/block.go:595 "only the Timestamp differs").
+# CanonicalVoteEncoder splices per-row; VoteRowTemplate goes further and
+# patches ALL rows of a commit in a handful of numpy passes — no
+# per-signature Python bytes objects at all. Byte-identical to
+# canonical_vote_bytes (property-fuzzed in tests/test_sign_template.py).
+
+_VARINT_MAX = 10  # 64-bit two's complement worst case
+
+
+def _vec_uvarint(vals: np.ndarray):
+    """(n,) uint64 -> ((n, 10) uint8 LEB128 bytes, (n,) int32 lengths).
+
+    Row i's encoding is out[i, :lens[i]] — identical to pe.uvarint."""
+    x = np.ascontiguousarray(np.asarray(vals, np.int64)).view(np.uint64)
+    x = x.copy()
+    n = x.shape[0]
+    out = np.zeros((n, _VARINT_MAX), np.uint8)
+    lens = np.ones(n, np.int32)
+    for j in range(_VARINT_MAX):
+        out[:, j] = (x & np.uint64(0x7F)).astype(np.uint8)
+        x >>= np.uint64(7)
+        cont = x != 0
+        out[:, j] |= cont.astype(np.uint8) << 7
+        lens += cont.astype(np.int32)
+    return out, lens
+
+
+class SignRows:
+    """A batch of canonical sign-bytes as one (n, L) uint8 matrix plus
+    per-row lengths — the zero-copy staging form the native/numpy pack
+    paths consume. Rows are right-padded with zeros."""
+
+    __slots__ = ("mat", "lens")
+
+    def __init__(self, mat: np.ndarray, lens: np.ndarray):
+        self.mat = mat
+        self.lens = np.asarray(lens, np.int64)
+
+    def __len__(self) -> int:
+        return self.mat.shape[0]
+
+    def row(self, i: int) -> bytes:
+        return self.mat[i, : self.lens[i]].tobytes()
+
+    def tolist(self) -> list:
+        """Per-row bytes. When every row has the same length (the common
+        commit shape: clustered timestamps) this is one flat tobytes()
+        plus cheap slicing instead of n numpy row copies."""
+        n = self.mat.shape[0]
+        if n == 0:
+            return []
+        L0 = int(self.lens[0])
+        if (self.lens == L0).all():
+            flat = self.mat[:, :L0].tobytes()
+            return [flat[i * L0:(i + 1) * L0] for i in range(n)]
+        return [self.mat[i, : int(self.lens[i])].tobytes()
+                for i in range(n)]
+
+
+class VoteRowTemplate:
+    """Vectorized row builder for one (chain_id, type, height, round,
+    block_id): the invariant prefix/suffix encode once, then
+    patch_rows() stamps any number of per-validator timestamps in a few
+    numpy passes. Shares the (pre, suf) template contract with
+    CanonicalVoteEncoder / native ed25519_pack_commits."""
+
+    # tag(5, WIRE_BYTES): the CanonicalVote timestamp field
+    TS_TAG = (5 << 3) | pe.WIRE_BYTES
+
+    def __init__(self, chain_id: str, vote_type: int, height: int,
+                 round_: int, block_id: Optional[BlockID]):
+        enc = CanonicalVoteEncoder(chain_id, vote_type, height, round_,
+                                   block_id)
+        pre, suf = enc.template
+        self._pre = pre
+        self._suf = suf
+        self._pre_arr = np.frombuffer(pre, np.uint8)
+        self._suf_arr = np.frombuffer(suf, np.uint8)
+
+    @property
+    def template(self) -> tuple:
+        """(prefix, suffix) — the native pack path's contract."""
+        return self._pre, self._suf
+
+    def bytes_for(self, ts: Timestamp) -> bytes:
+        """Single-row splice (CanonicalVoteEncoder semantics)."""
+        body = (self._pre + pe.f_msg(5, pe.timestamp(ts.seconds, ts.nanos))
+                + self._suf)
+        return pe.delimited(body)
+
+    def patch_rows(self, secs: Sequence[int],
+                   nanos: Sequence[int]) -> SignRows:
+        """Stamp n timestamps into the template: (n,) seconds + (n,)
+        nanos -> SignRows of complete length-prefixed sign-bytes.
+
+        Handles every varint width (including negative seconds/nanos as
+        64-bit two's complement, matching pe.varint) and the zero-
+        skipping rules of the scalar encoder."""
+        secs = np.asarray(secs, np.int64)
+        nanos = np.asarray(nanos, np.int64)
+        n = secs.shape[0]
+        P, S = self._pre_arr.size, self._suf_arr.size
+        sb, sl = _vec_uvarint(secs)
+        nb, nl = _vec_uvarint(nanos)
+        s_nz = secs != 0
+        n_nz = nanos != 0
+        sfl = np.where(s_nz, sl + 1, 0)      # field-1 bytes (tag + varint)
+        nfl = np.where(n_nz, nl + 1, 0)      # field-2 bytes
+        ts_len = sfl + nfl                   # Timestamp body (< 128)
+        body_len = P + 2 + ts_len + S        # + tag(5) + 1-byte msg len
+        ob, ol = _vec_uvarint(body_len)
+        total = ol + body_len
+        mat = np.zeros((n, int(total.max()) if n else 0), np.uint8)
+        r = np.arange(n)
+        for j in range(int(ol.max()) if n else 0):
+            m = ol > j
+            mat[m, j] = ob[m, j]
+        off = ol.astype(np.int64)
+        if P:
+            mat[r[:, None], off[:, None] + np.arange(P)] = self._pre_arr
+        off += P
+        mat[r, off] = self.TS_TAG
+        mat[r, off + 1] = ts_len.astype(np.uint8)
+        off += 2
+        if s_nz.any():
+            mat[r[s_nz], off[s_nz]] = 0x08   # tag(1, VARINT)
+            for j in range(int(sl[s_nz].max())):
+                m = s_nz & (sl > j)
+                mat[r[m], off[m] + 1 + j] = sb[m, j]
+        off = off + sfl
+        if n_nz.any():
+            mat[r[n_nz], off[n_nz]] = 0x10   # tag(2, VARINT)
+            for j in range(int(nl[n_nz].max())):
+                m = n_nz & (nl > j)
+                mat[r[m], off[m] + 1 + j] = nb[m, j]
+        off = off + nfl
+        if S:
+            mat[r[:, None], off[:, None] + np.arange(S)] = self._suf_arr
+        return SignRows(mat, total)
 
 
 def canonical_proposal_bytes(

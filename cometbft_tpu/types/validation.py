@@ -57,6 +57,36 @@ def _should_batch_verify(commit: Commit) -> bool:
     return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
 
 
+# Template packing (the zero-copy hot path): batch verification builds
+# its sign-bytes via Commit.sign_bytes_rows (vectorized numpy template
+# patching) instead of the per-vote encode loop. The toggle exists for
+# the legacy/differential path only — bytes are identical either way
+# (tests/test_sign_template.py property fuzz + the simnet determinism
+# scenario), so flipping it must never change behavior.
+_TEMPLATE_PACK = True
+
+
+def set_template_packing(on: bool) -> bool:
+    """Enable/disable the vectorized template-packing path; returns the
+    previous setting (tests and the simnet determinism guard)."""
+    global _TEMPLATE_PACK
+    prev = _TEMPLATE_PACK
+    _TEMPLATE_PACK = bool(on)
+    return prev
+
+
+def template_packing_enabled() -> bool:
+    return _TEMPLATE_PACK
+
+
+def _commit_msgs(chain_id: str, commit: Commit, idxs) -> List[bytes]:
+    """Sign-bytes for the collected signature indices: one vectorized
+    template patch per commit, or the legacy per-vote encode loop."""
+    if _TEMPLATE_PACK:
+        return commit.sign_bytes_rows(chain_id, idxs)
+    return [commit.vote_sign_bytes(chain_id, i) for i in idxs]
+
+
 def verify_commit(
     chain_id: str,
     vals: ValidatorSet,
@@ -212,7 +242,6 @@ def _verify_batch(
       single-verify fallback would stop.
     """
     pubs: List = []  # crypto.keys.PubKey — batch_fn groups by key_type
-    msgs: List[bytes] = []
     sigs: List[bytes] = []
     idxs: List[int] = []
     tallied = 0
@@ -233,7 +262,6 @@ def _verify_batch(
             seen.add(cs.validator_address)
         pub_key, power = resolved
         pubs.append(pub_key)
-        msgs.append(commit.vote_sign_bytes(chain_id, idx))
         sigs.append(cs.signature)
         idxs.append(idx)
         if count_sig(cs):
@@ -244,6 +272,9 @@ def _verify_batch(
     if tallied <= voting_power_needed:
         raise NotEnoughPowerError(tallied, voting_power_needed)
 
+    # sign-bytes built AFTER collection: one vectorized template patch
+    # over the collected rows (template packing), or the legacy loop
+    msgs = _commit_msgs(chain_id, commit, idxs)
     valid = np.asarray(batch_fn(pubs, msgs, sigs))[: len(pubs)]
     if not valid.all():
         bad = int(np.flatnonzero(~valid)[0])
@@ -355,3 +386,50 @@ def oracle_batch_fn() -> Callable:
         )
 
     return fn
+
+
+def commit_packed_batch(chain_id: str, commit: Commit, keys, idxs=None,
+                        pad_to: Optional[int] = None):
+    """Zero-copy staging of a commit's signatures for the device
+    verifier: commit -> PackedBatch without ever materializing per-row
+    Python sign-bytes.
+
+    keys[i] is validator i's 32-byte ed25519 pubkey (valset order). The
+    native path assembles sign-bytes in C from the commit's (pre, suf)
+    templates + per-row timestamps (ed25519_pack_commits); the fallback
+    patches the numpy templates (Commit.sign_bytes_rows) and feeds
+    pack_batch. Both are byte-identical to the legacy per-vote path.
+
+    Returns (PackedBatch, row_idxs) with row k of the batch holding
+    commit-signature row_idxs[k]."""
+    from cometbft_tpu import native
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    sigs_all = commit.signatures
+    if idxs is None:
+        idxs = [i for i, cs in enumerate(sigs_all)
+                if cs.for_block() and i < len(keys)]
+    pubs = [keys[i] for i in idxs]
+    sigs = [sigs_all[i].signature for i in idxs]
+    n = len(idxs)
+    padded = pad_to if pad_to is not None else ek.bucket_size(max(n, 1))
+    if (native.available() and n
+            and all(len(p) == 32 for p in pubs)
+            and all(len(s) == 64 for s in sigs)):
+        tmpl_b, tmpl_n = commit.sign_bytes_template(chain_id)
+        secs = np.asarray([sigs_all[i].timestamp.seconds for i in idxs],
+                          np.int64)
+        nanos = np.asarray([sigs_all[i].timestamp.nanos for i in idxs],
+                           np.int64)
+        nil = np.asarray(
+            [not sigs_all[i].is_commit() for i in idxs], np.int32
+        )
+        packed = native.ed25519_pack_commits(
+            b"".join(pubs), b"".join(sigs),
+            [tmpl_b.template, tmpl_n.template], nil,
+            secs, nanos, padded,
+        )
+        if packed is not None:
+            return ek.PackedBatch(n, padded, *packed), idxs
+    msgs = _commit_msgs(chain_id, commit, idxs)
+    return ek.pack_batch(pubs, msgs, sigs, pad_to=padded), idxs

@@ -29,10 +29,14 @@ class _Plan:
     including) the device dispatch. Splitting plan from execution lets
     the plane consume a circuit-breaker probe slot only when a device
     attempt actually happens (an ineligible flush must not burn the
-    breaker's half-open probe)."""
+    breaker's half-open probe). dispatch_fused() then launches the
+    kernel WITHOUT fetching (pending holds the in-flight device
+    arrays), and collect_fused() blocks for the verdicts — the split
+    that lets the plane pack flush k+1 while flush k flies."""
 
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
-                 "counted_pos", "n_commits", "pubs_v", "powers_v")
+                 "counted_pos", "n_commits", "pubs_v", "powers_v",
+                 "pending")
 
 
 def _eligible(batch):
@@ -60,11 +64,12 @@ def _eligible(batch):
     return pubs0, powers0
 
 
-def plan_fused(batch) -> Optional[_Plan]:
+def plan_fused(batch, pool=None) -> Optional[_Plan]:
     """Host-side staging of the fused cached-table dispatch for a
     flush. Returns a _Plan, or None when the flush shape is ineligible
     — the caller then runs the generic grouped path. No device work
-    happens here (run_fused does that, under the breaker)."""
+    happens here (dispatch_fused/collect_fused do that, under the
+    breaker)."""
     import jax
 
     if jax.default_backend() == "cpu":
@@ -128,18 +133,27 @@ def plan_fused(batch) -> Optional[_Plan]:
     n_commits = len(groups)
     pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
     pos = np.asarray(row_pos, np.int64)
-    ry = np.zeros((B, pbd.ry.shape[1]), pbd.ry.dtype)
+    # pinned double-buffered staging: the scatter targets and the final
+    # packed rows rotate through persistent host buffers per shape (the
+    # CALLER's pool — one writer per key; the plane passes its private
+    # pool), so packing flush k+1 never touches the memory flush k is
+    # still uploading from
+    if pool is None:
+        from cometbft_tpu.crypto.batch import staging_pool
+
+        pool = staging_pool()
+    ry = pool.get("fused.ry", (B, pbd.ry.shape[1]), pbd.ry.dtype)
     ry[pos] = pbd.ry[:n]
-    rsign = np.zeros(B, np.int32)
+    rsign = pool.get("fused.rsign", (B,), np.int32)
     rsign[pos] = np.asarray(pbd.rsign[:n], np.int32)
-    sdig = np.zeros((B, pbd.sdig.shape[1]), pbd.sdig.dtype)
+    sdig = pool.get("fused.sdig", (B, pbd.sdig.shape[1]), pbd.sdig.dtype)
     sdig[pos] = pbd.sdig[:n]
-    hdig = np.zeros((B, pbd.hdig.shape[1]), pbd.hdig.dtype)
+    hdig = pool.get("fused.hdig", (B, pbd.hdig.shape[1]), pbd.hdig.dtype)
     hdig[pos] = pbd.hdig[:n]
-    precheck = np.zeros(B, np.bool_)
+    precheck = pool.get("fused.precheck", (B,), np.bool_)
     precheck[pos] = np.asarray(pbd.precheck[:n], np.bool_)
-    counted = np.zeros(B, np.bool_)
-    commit_ids = np.zeros(B, np.int32)
+    counted = pool.get("fused.counted", (B,), np.bool_)
+    commit_ids = pool.get("fused.cid", (B,), np.int32)
     cur = 0
     for sub, gid, cpos in zip(batch, sub_gid, counted_pos):
         for p in row_pos[cur:cur + len(sub.rows)]:
@@ -152,8 +166,11 @@ def plan_fused(batch) -> Optional[_Plan]:
         thresh[gid] = ek.threshold_limbs(max(g.threshold - 1, 0))[0]
 
     pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
+    out = pool.get("fused.rows", ec.packed_rows_shape(B, n_commits),
+                   np.int32)
     plan = _Plan()
-    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh)
+    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh,
+                                    out=out)
     plan.pos = pos
     plan.batch = batch
     plan.groups = groups
@@ -162,24 +179,45 @@ def plan_fused(batch) -> Optional[_Plan]:
     plan.n_commits = n_commits
     plan.pubs_v = pubs_v
     plan.powers_v = powers_v
+    plan.pending = None
     return plan
 
 
-def run_fused(plan: _Plan) -> Tuple[List[bool], Dict[object, int]]:
-    """Execute a staged plan on the device: build/fetch the valset
-    window table, run the fused verify+tally kernel, gate the tallies
-    per submission. Raises on device faults (the caller's breaker
-    handles those).
+def plan_h2d_bytes(plan: _Plan) -> int:
+    """Bytes this flush stages to the device (the packed rows; the
+    valset table is device-resident and uploads once per valset)."""
+    return int(plan.rows.nbytes)
+
+
+def dispatch_fused(plan: _Plan) -> None:
+    """Launch a staged plan on the device WITHOUT fetching: fetch the
+    (device-resident, valset-keyed) window table and enqueue the fused
+    verify+tally kernel. Returns as soon as the dispatch is in flight
+    (JAX async dispatch) so the caller can pack the next flush. Raises
+    on dispatch-time device faults (the caller's breaker handles
+    those). The rows buffer is dead once the kernel has read it, and
+    the staging pool rotation guarantees the host copy isn't reused
+    until this flight lands."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    # pubs_v/powers_v are the QuorumGroup's immutable tuples, so the
+    # content-key digest is identity-memoized (no per-flush O(valset)
+    # hashing) and a steady-state flush never re-uploads the valset
+    table = ec.table_for_pubs(plan.pubs_v, plan.powers_v)
+    plan.pending = ec.verify_tally_rows_cached(
+        plan.rows, table, plan.n_commits
+    )
+
+
+def collect_fused(plan: _Plan) -> Tuple[List[bool], Dict[object, int]]:
+    """Block for a dispatched plan's results and gate the tallies per
+    submission. Raises on in-flight device faults.
 
     Returns (per-row verdicts in flush order, {group: verified power
     tallied by the device this flush})."""
-    from cometbft_tpu.ops import ed25519_cached as ec
     from cometbft_tpu.ops import ed25519_kernel as ek
 
-    table = ec.table_for_pubs(list(plan.pubs_v), list(plan.powers_v))
-    valid, tally, _quorum = ec.verify_tally_rows_cached(
-        plan.rows, table, plan.n_commits
-    )
+    valid, tally, _quorum = plan.pending
     valid = np.asarray(valid)
     tallies_raw = ek.tally_to_int(np.asarray(tally))
 

@@ -201,6 +201,7 @@ class VerifyPlane:
                  kernels: Optional[dict] = None, breaker=None,
                  use_device: Optional[bool] = None):
         from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.libs.staging import StagingPool
 
         self.window = max(0.0, window_ms) / 1000.0
         self.max_batch = max(1, int(max_batch))
@@ -226,6 +227,13 @@ class VerifyPlane:
         self.batches = 0
         self.rows_verified = 0
         self.padding_waste = 0
+        self.pack_seconds = 0.0   # host staging time (template pack etc.)
+        self.h2d_bytes = 0        # bytes staged to the device
+        self.overlapped = 0       # flushes packed while another flew
+        # PRIVATE staging pool: the rotation contract (one writer per
+        # key) only holds per dispatcher thread — two planes in one
+        # process (multi-node tests, simnet) must never share slots
+        self._staging = StagingPool(slots=2)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -353,6 +361,16 @@ class VerifyPlane:
     # -- dispatcher --------------------------------------------------------
 
     def _run(self) -> None:
+        """Double-buffered dispatch loop: while flush k flies on the
+        device, the dispatcher drains and PACKS flush k+1 into the
+        rotated staging buffers (libs/staging.py), settling k only
+        after k+1's dispatch is in flight — the blocksync pipeline's
+        overlap (pipeline.py "host packs chunk k+1 while the device
+        works"), generalized to every caller of the plane. With a
+        flush already in flight the window wait is skipped: the
+        in-flight pass IS the coalescing amortization the window
+        exists to provide."""
+        inflight = None  # airborne (batch, finish, True) device flight
         while True:
             batch: List[_Submission] = []
             with self._cv:
@@ -360,14 +378,17 @@ class VerifyPlane:
                     if self._pending:
                         age = time.perf_counter() - \
                             self._pending[0].t_submit
-                        if (age >= self.window
+                        if (inflight is not None
+                                or age >= self.window
                                 or self._pending_rows >= self.max_batch):
                             break
                         self._cv.wait(timeout=self.window - age)
+                    elif inflight is not None:
+                        break  # nothing to pack: settle the flight now
                     else:
                         self._cv.wait(timeout=0.25)
                 if not self._running and not self._pending:
-                    return
+                    break
                 # drain whole submissions up to max_batch rows (a lone
                 # oversized submission still dispatches alone)
                 rows = 0
@@ -382,56 +403,100 @@ class VerifyPlane:
                 if self.metrics is not None:
                     self.metrics.plane_queue_depth.set(self._pending_rows)
                 self._cv.notify_all()  # wake backpressured submitters
-            if batch:
-                self._dispatch(batch)
+            flight = self._stage(batch) if batch else None
+            if inflight is not None:
+                # real overlap only: the previous flight was airborne on
+                # the device while this flush packed on the host
+                if flight is not None:
+                    self.overlapped += 1
+                self._finish_flight(inflight)
+                inflight = None
+            if flight is not None:
+                if flight[2]:
+                    inflight = flight  # device pass in flight: defer
+                else:
+                    # synchronous flush (host path / grouped device):
+                    # verdicts are already final — settle NOW, deferring
+                    # would add a whole flush of latency for no overlap
+                    self._finish_flight(flight)
+        if inflight is not None:
+            self._finish_flight(inflight)
 
-    def _dispatch(self, batch: List[_Submission]) -> None:
+    def _finish_flight(self, flight) -> None:
+        batch, finish, _airborne = flight
+        verdicts, fused_tallies = finish()
+        self._settle(batch, verdicts, fused_tallies=fused_tallies)
+
+    def _observe_pack(self, seconds: float, h2d_bytes: int = 0) -> None:
+        self.pack_seconds += seconds
+        self.h2d_bytes += h2d_bytes
+        if self.metrics is not None:
+            self.metrics.plane_pack_seconds.observe(seconds)
+            if h2d_bytes:
+                self.metrics.plane_h2d_bytes.inc(h2d_bytes)
+
+    def _stage(self, batch: List[_Submission]):
+        """Pack one flush and (when eligible) launch it on the device
+        WITHOUT waiting for results. Returns (batch, finish) where
+        finish() blocks for the verdicts — the seam that lets the
+        dispatcher pack the next flush while this one flies.
+
+        The breaker's allow() — which consumes the single half-open
+        probe slot when the breaker is open — is only asked once a
+        fused plan exists, i.e. when a device attempt will actually
+        happen; an ineligible flush must not burn the probe the
+        generic path needs to recover."""
         rows = [r for sub in batch for r in sub.rows]
-        fused = None
+        t0 = time.perf_counter()
         try:
             fp.fail_point("verifyplane.dispatch")
-            fused = self._try_fused(batch)
-            verdicts = fused[0] if fused is not None \
-                else self._verify_rows(rows)
         except Exception:  # noqa: BLE001 - dispatch fault, not verdicts
             _log.exception(
                 "verify plane dispatch fault (%d rows); degrading this "
                 "flush to the inline host path", len(rows),
             )
-            fused = None
             verdicts = _host_verdicts(rows)
-        self._settle(batch, verdicts,
-                     fused_tallies=fused[1] if fused else None)
+            return batch, (lambda: (verdicts, None)), False
+        plan = None
+        if self._use_device and self._kernels is None:
+            from cometbft_tpu.verifyplane import fused as fz
 
-    def _try_fused(self, batch):
-        """The cached-valset fused verify+tally device pass, when the
-        flush shape allows it (see fused.plan_fused). The breaker's
-        allow() — which consumes the single half-open probe slot when
-        the breaker is open — is only asked once a plan exists, i.e.
-        when a device attempt will actually happen; an ineligible flush
-        must not burn the probe the generic path needs to recover."""
-        if not self._use_device or self._kernels is not None:
-            return None
-        from cometbft_tpu.verifyplane import fused as fz
+            try:
+                plan = fz.plan_fused(batch, pool=self._staging)
+            except Exception:  # noqa: BLE001 - staging bug, not device
+                _log.exception("fused flush staging failed; grouped path")
+                plan = None
+            if plan is not None and not self._breaker.allow():
+                plan = None
+        if plan is not None:
+            try:
+                fz.dispatch_fused(plan)
+                self._observe_pack(time.perf_counter() - t0,
+                                   fz.plan_h2d_bytes(plan))
 
-        try:
-            plan = fz.plan_fused(batch)
-        except Exception:  # noqa: BLE001 - host staging bug, not device
-            _log.exception("fused flush staging failed; grouped path")
-            return None
-        if plan is None or not self._breaker.allow():
-            return None
-        try:
-            out = fz.run_fused(plan)
-        except Exception:  # noqa: BLE001 - device fault
-            self._breaker.record_failure()
-            _log.exception(
-                "fused verify-plane dispatch failed; falling back to "
-                "the grouped path"
-            )
-            return None
-        self._breaker.record_success()
-        return out
+                def finish():
+                    try:
+                        out = fz.collect_fused(plan)
+                    except Exception:  # noqa: BLE001 - device fault
+                        self._breaker.record_failure()
+                        _log.exception(
+                            "fused verify-plane flush failed in flight; "
+                            "host fallback for this flush"
+                        )
+                        return _host_verdicts(rows), None
+                    self._breaker.record_success()
+                    return out
+
+                return batch, finish, True
+            except Exception:  # noqa: BLE001 - device fault at dispatch
+                self._breaker.record_failure()
+                _log.exception(
+                    "fused verify-plane dispatch failed; falling back "
+                    "to the grouped path"
+                )
+        self._observe_pack(time.perf_counter() - t0)
+        verdicts = self._verify_rows(rows)
+        return batch, (lambda: (verdicts, None)), False
 
     def _verify_rows(self, rows) -> List[bool]:
         """One padded device pass under the circuit breaker, or the
@@ -509,6 +574,9 @@ class VerifyPlane:
             "padding_waste": self.padding_waste,
             "breaker_state": self._breaker.state,
             "use_device": self._use_device,
+            "pack_seconds": self.pack_seconds,
+            "h2d_bytes": self.h2d_bytes,
+            "overlapped": self.overlapped,
         }
 
 

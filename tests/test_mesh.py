@@ -41,6 +41,28 @@ def test_rows_builders_memoized_and_share_verify_program():
                if key[0] == "pallas-verify") == 1
 
 
+def test_step_cache_hit_counters():
+    """ISSUE 4 satellite (MULTICHIP_r05 rc=124 guard): the memoized
+    builders expose hit/miss counters, and REPEATED builder calls are
+    observable HITS — a regression back to per-call shard_map rebuilds
+    would show up as misses here (and as minutes of recompile on the
+    harness). Pure cache identity, no compiles."""
+    mesh = pm.make_mesh()
+    pm.sharded_verify_tally(mesh, 3)  # ensure the entry exists
+    before = pm.cache_stats()
+    for _ in range(4):
+        pm.sharded_verify_tally(mesh, 3)
+    after = pm.cache_stats()
+    assert after["hits"] >= before["hits"] + 4
+    assert after["misses"] == before["misses"]
+    # a NEW width is one miss (the cheap tally step), then hits
+    pm.sharded_verify_tally(mesh, 5)
+    mid = pm.cache_stats()
+    assert mid["misses"] == after["misses"] + 1
+    pm.sharded_verify_tally(mesh, 5)
+    assert pm.cache_stats()["hits"] == mid["hits"] + 1
+
+
 def test_rows_split_plumbing_with_stub_kernel(monkeypatch):
     """Execute the split verify->tally pipeline over the 8-device mesh
     with a STUB verify kernel (the real Pallas program costs minutes of

@@ -1,0 +1,268 @@
+"""Template row packing (the zero-copy verify hot path): property-style
+byte-equality of the vectorized patch paths against the legacy per-vote
+encoders, across fuzzed heights/rounds/timestamps/BlockIDs/chain ids.
+
+Host-only numpy — no kernels, no compiles (tier-1 friendly)."""
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types import validation as tv
+from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+from cometbft_tpu.types.commit import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    Commit,
+    CommitSig,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import sign_bytes_template
+
+# timestamps chosen to cross every varint width boundary, including the
+# zero-skipping cases and the 10-byte two's-complement negatives
+FUZZ_SECS = [0, 1, 127, 128, 16383, 16384, 1_700_000_000, 2**31 - 1,
+             2**31, 2**40, 2**62, -1, -2**33]
+FUZZ_NANOS = [0, 1, 127, 128, 999_999_999, 5, 42, -7]
+
+
+def _bids():
+    return [
+        None,
+        BlockID(),
+        BlockID(b"\xab" * 32, PartSetHeader(2, b"\xcd" * 32)),
+        BlockID(b"\x00" * 32, PartSetHeader(1, b"\x11" * 32)),
+    ]
+
+
+def test_patch_rows_matches_canonical_vote_bytes_fuzzed():
+    """The acceptance property: template-packed rows are byte-identical
+    to per-vote canonical_vote_bytes for every fuzzed combination —
+    including chain ids sized to push the outer length prefix across
+    the 127/128 one-vs-two-byte varint boundary."""
+    rng = random.Random(1234)
+    chains = ["a", "zero-copy-chain", "c" * 49, "q" * 107, "w" * 120]
+    checked = 0
+    for chain in chains:
+        for bid in _bids():
+            for vote_type in (canonical.PREVOTE_TYPE,
+                              canonical.PRECOMMIT_TYPE):
+                h = rng.choice([0, 1, 4096, 2**31, 2**62 - 1])
+                r = rng.choice([0, 1, 255])
+                tmpl = sign_bytes_template(chain, vote_type, h, r, bid)
+                secs = [rng.choice(FUZZ_SECS) for _ in range(24)]
+                nanos = [rng.choice(FUZZ_NANOS) for _ in range(24)]
+                rows = tmpl.patch_rows(secs, nanos)
+                lst = rows.tolist()
+                for i, (s, nn) in enumerate(zip(secs, nanos)):
+                    exp = canonical.canonical_vote_bytes(
+                        chain, vote_type, h, r, bid, Timestamp(s, nn)
+                    )
+                    assert rows.row(i) == exp, (chain, bid, h, r, s, nn)
+                    assert lst[i] == exp
+                    checked += 1
+    assert checked >= 500
+
+
+def test_patch_rows_empty_and_singleton():
+    tmpl = sign_bytes_template("c", canonical.PRECOMMIT_TYPE, 3, 0, None)
+    assert tmpl.patch_rows([], []).tolist() == []
+    one = tmpl.patch_rows([7], [0])
+    assert one.row(0) == canonical.canonical_vote_bytes(
+        "c", canonical.PRECOMMIT_TYPE, 3, 0, None, Timestamp(7, 0)
+    )
+
+
+def _fixture_commit(n=12, height=9, round_=2, seed=50):
+    privs = [PrivKey.generate(bytes([seed + i]) * 32) for i in range(n)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by = {p.pub_key().address(): p for p in privs}
+    bid = BlockID(b"\x77" * 32, PartSetHeader(3, b"\x88" * 32))
+    sigs = []
+    for idx, v in enumerate(vs.validators):
+        if idx == 4:
+            sigs.append(CommitSig(BLOCK_ID_FLAG_ABSENT))
+            continue
+        nil = idx == 7
+        ts = Timestamp(1_700_000_000 + idx * 129, idx * 1000)
+        sb = canonical.canonical_vote_bytes(
+            "tmpl-chain", canonical.PRECOMMIT_TYPE, height, round_,
+            None if nil else bid, ts,
+        )
+        sigs.append(CommitSig(
+            BLOCK_ID_FLAG_NIL if nil else BLOCK_ID_FLAG_COMMIT,
+            v.address, ts, by[v.address].sign(sb),
+        ))
+    return vs, Commit(height, round_, bid, sigs), bid
+
+
+def test_commit_sign_bytes_rows_matches_per_vote():
+    """Commit.sign_bytes_rows (mixed for-block / nil / absent rows) is
+    byte-equal to the legacy vote_sign_bytes loop, over any index
+    subset and in subset order."""
+    _, commit, _ = _fixture_commit()
+    n = len(commit.signatures)
+    all_idx = list(range(n))
+    assert commit.sign_bytes_rows("tmpl-chain", all_idx) == [
+        commit.vote_sign_bytes("tmpl-chain", i) for i in all_idx
+    ]
+    sub = [7, 1, 11, 3]
+    assert commit.sign_bytes_rows("tmpl-chain", sub) == [
+        commit.vote_sign_bytes("tmpl-chain", i) for i in sub
+    ]
+    # a different chain id invalidates the cached templates
+    assert commit.sign_bytes_rows("other", [1]) == [
+        commit.vote_sign_bytes("other", 1)
+    ]
+
+
+def test_verify_commit_template_toggle_equivalence():
+    """verify_commit passes with the oracle batch_fn under BOTH packing
+    paths, and a wrong-signature commit is blamed identically — the
+    toggle must never change behavior (simnet determinism guard's
+    local half)."""
+    vs, commit, bid = _fixture_commit()
+    for on in (True, False):
+        prev = tv.set_template_packing(on)
+        try:
+            tv.verify_commit("tmpl-chain", vs, bid, 9, commit,
+                             batch_fn=tv.oracle_batch_fn())
+            bad = Commit(commit.height, commit.round, commit.block_id,
+                         list(commit.signatures))
+            cs = bad.signatures[2]
+            bad.signatures[2] = CommitSig(cs.flag, cs.validator_address,
+                                          cs.timestamp, b"\x5a" * 64)
+            with pytest.raises(tv.InvalidSignatureError) as ei:
+                tv.verify_commit("tmpl-chain", vs, bid, 9, bad,
+                                 batch_fn=tv.oracle_batch_fn())
+            assert ei.value.idx == 2
+        finally:
+            tv.set_template_packing(prev)
+
+
+def test_commit_packed_batch_matches_pack_batch():
+    """The zero-copy staging path (native template pack when available,
+    numpy template fallback otherwise) produces the exact arrays of the
+    legacy msgs+pack_batch pipeline."""
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    vs, commit, bid = _fixture_commit()
+    keys = [v.pub_key.data for v in vs.validators]
+    pb, idxs = tv.commit_packed_batch("tmpl-chain", commit, keys)
+    assert idxs == [i for i, cs in enumerate(commit.signatures)
+                    if cs.for_block()]
+    msgs = [commit.vote_sign_bytes("tmpl-chain", i) for i in idxs]
+    ref = ek.pack_batch([keys[i] for i in idxs], msgs,
+                        [commit.signatures[i].signature for i in idxs],
+                        pad_to=pb.padded)
+    for name in ("ay", "asign", "ry", "rsign", "sdig", "hdig",
+                 "precheck"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(pb, name)), np.asarray(getattr(ref, name)),
+            err_msg=name,
+        )
+
+
+def test_pack_rows_cached_out_buffer_parity():
+    """pack_rows_cached into a rotated (zeroed) staging buffer is
+    bit-identical to the allocating path, including threshold rows and
+    dead padding — the double-buffer must never leak a previous
+    flush's rows."""
+    from cometbft_tpu.libs.staging import StagingPool
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    vs, commit, bid = _fixture_commit()
+    keys = [v.pub_key.data for v in vs.validators]
+    pb, idxs = tv.commit_packed_batch("tmpl-chain", commit, keys,
+                                      pad_to=128)
+    counted = np.zeros(128, np.bool_)
+    counted[: len(idxs)] = True
+    cids = np.zeros(128, np.int32)
+    thresh = ek.threshold_limbs(77)
+    ref = ec.pack_rows_cached(pb, counted, cids, thresh)
+    pool = StagingPool(slots=2)
+    a = pool.get("rows", ref.shape, np.int32)
+    a[:] = -1  # dirty slot A, rotate past it so the pool re-zeroes
+    pool.get("rows", ref.shape, np.int32)
+    out = pool.get("rows", ref.shape, np.int32)
+    assert out is a
+    got = ec.pack_rows_cached(pb, counted, cids, thresh, out=out)
+    assert got is out
+    np.testing.assert_array_equal(got, ref)
+    # a mismatched out buffer is ignored, not corrupted
+    wrong = np.full((ref.shape[0] + 1, ref.shape[1]), 3, np.int32)
+    got2 = ec.pack_rows_cached(pb, counted, cids, thresh, out=wrong)
+    assert got2 is not wrong
+    np.testing.assert_array_equal(got2, ref)
+
+
+def test_table_for_valset_identity_memo(monkeypatch):
+    """ed25519_cached.table_for_valset: memoized by ValidatorSet
+    identity, invalidated when update_with_change_set replaces the
+    validators list (the only mutation that can change keys/powers).
+    The underlying build is stubbed — no device table on CPU."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    calls = []
+
+    def fake_table_for_pubs(pubs, powers=None):
+        calls.append((pubs, powers))
+        return "TBL%d" % len(calls)
+
+    monkeypatch.setattr(ec, "table_for_pubs", fake_table_for_pubs)
+    vs, _, _ = _fixture_commit()
+    ec._VALSET_MEMO.clear()
+    try:
+        t1 = ec.table_for_valset(vs)
+        t2 = ec.table_for_valset(vs)
+        assert t1 is t2 and len(calls) == 1
+        st = ec.table_cache_stats()
+        assert st["valset_hits"] >= 1
+        # a wholesale validators-list replacement (what
+        # update_with_change_set does) must invalidate the memo
+        vs.validators = list(vs.validators)
+        ec.table_for_valset(vs)
+        assert len(calls) == 2
+    finally:
+        ec._VALSET_MEMO.clear()
+
+
+def test_packed_rows_shape_matches_pack_rows_cached():
+    """The staging-buffer sizing helper agrees with what
+    pack_rows_cached actually builds, across thresh widths."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    vs, commit, _ = _fixture_commit()
+    keys = [v.pub_key.data for v in vs.validators]
+    pb, idxs = tv.commit_packed_batch("tmpl-chain", commit, keys,
+                                      pad_to=128)
+    for n_commits in (1, 3, 64):
+        thresh = np.zeros((n_commits, ek.TALLY_LIMBS), np.int32)
+        rows = ec.pack_rows_cached(pb, None, None, thresh)
+        assert rows.shape == ec.packed_rows_shape(128, n_commits)
+
+
+def test_staging_pool_rotation_and_reuse():
+    """libs/staging: two slots per shape rotate; a third request
+    returns the first buffer again, zeroed."""
+    from cometbft_tpu.libs.staging import StagingPool
+
+    p = StagingPool(slots=2)
+    a = p.get("rows", (3, 4), np.int32)
+    a[:] = 9
+    b = p.get("rows", (3, 4), np.int32)
+    assert b is not a
+    c = p.get("rows", (3, 4), np.int32)
+    assert c is a and (c == 0).all()
+    # distinct shapes/names never alias
+    d = p.get("rows", (3, 5), np.int32)
+    e = p.get("other", (3, 4), np.int32)
+    assert d is not a and e is not a
+    st = p.stats()
+    assert st["hits"] == 1 and st["misses"] == 4

@@ -71,6 +71,45 @@ def test_determinism_same_seed_same_chain(tmp_path):
     assert run_once("a") == run_once("b")
 
 
+def test_template_packing_determinism_vs_legacy(tmp_path):
+    """ISSUE 4 satellite (zero-copy hot path): the same (seed,
+    schedule) with the template-packing path FORCED ON yields commit
+    hashes byte-identical to the legacy per-vote packing path at every
+    height on every node — a patching bug that rejected (or mis-built)
+    any sign-bytes would wedge a round or fork the runs. Also checks a
+    REAL committed commit's template rows against its per-vote
+    sign-bytes, byte for byte."""
+    from cometbft_tpu.types import validation as tv
+
+    sched = [
+        {"at": 0.05, "op": "link", "drop": 0.05, "delay": 0.01,
+         "jitter": 0.005},
+        {"at": 0.3, "op": "tx", "node": 1, "data": b"zero=copy".hex()},
+    ]
+
+    def run_once(tag, on):
+        prev = tv.set_template_packing(on)
+        try:
+            assert tv.template_packing_enabled() == on
+            with Simnet(4, seed=44, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(sched, until_height=2, max_time=120.0)
+                sim.assert_safety()
+                hashes = sim.commit_hashes()
+                # byte-level guard on a commit the network produced
+                store = sim.net.nodes[0].node.block_store
+                commit = store.load_seen_commit(1)
+                chain = sim.net.chain_id
+                idxs = list(range(len(commit.signatures)))
+                assert commit.sign_bytes_rows(chain, idxs) == [
+                    commit.vote_sign_bytes(chain, i) for i in idxs
+                ]
+                return hashes
+        finally:
+            tv.set_template_packing(prev)
+
+    assert run_once("tmpl", True) == run_once("legacy", False)
+
+
 def test_partition_minority_stalls_then_catches_up(tmp_path):
     """A partitioned validator cannot commit (safety) while the 3/4
     majority keeps going; after heal the catch-up pushes restore it."""

@@ -468,8 +468,33 @@ def test_plane_metrics_exposed(plane):
         "cometbft_verifyplane_batch_size",
         "cometbft_verifyplane_submit_to_result_seconds",
         "cometbft_verifyplane_padding_waste_total",
+        "cometbft_verifyplane_pack_seconds",
+        "cometbft_verifyplane_h2d_bytes_total",
         "cometbft_crypto_breaker_open",
     ):
         assert name in text, name
     # the flush recorded a batch and a latency observation
     assert "cometbft_verifyplane_batch_size_count" in text
+
+
+def test_plane_pack_metrics_and_overlap_counters(plane):
+    """ISSUE 4 satellite: every flush observes its host staging time
+    (verifyplane_pack_seconds) and stats() carries the zero-copy
+    counters; on the CPU host path nothing is uploaded, so the H2D
+    byte counter stays zero."""
+    from cometbft_tpu.libs.metrics import NodeMetrics
+
+    m = NodeMetrics()
+    plane.metrics = m
+    pubs, msgs, sigs, _ = make_rows(6)
+    plane.submit_and_wait(pubs, msgs, sigs)
+    st = plane.stats()
+    assert st["pack_seconds"] > 0.0
+    assert st["h2d_bytes"] == 0  # host path: no device staging
+    assert st["overlapped"] >= 0
+    text = m.expose_text()
+    assert "cometbft_verifyplane_pack_seconds_count" in text
+    # at least one pack observation landed in the histogram
+    count_line = [ln for ln in text.splitlines()
+                  if ln.startswith("cometbft_verifyplane_pack_seconds_count")]
+    assert count_line and float(count_line[0].split()[-1]) >= 1
