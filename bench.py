@@ -781,9 +781,27 @@ def headline_10k():
     return cpu_ms, raw, steady, pack_ms, tbl_ms, resident, overlap
 
 
-def main():
+TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description="BASELINE configs bench")
+    ap.add_argument(
+        "--trace-out", default="",
+        help="path prefix: run cfg2/cfg6 with tracing ON, write "
+             "<prefix>.<cfg>.trace.json (perfetto-loadable) and embed "
+             "the trace-derived stage table in each config's JSON. "
+             "Tracing stays OFF for every other config and when the "
+             "flag is absent — the headline numbers are untraced.")
+    args = ap.parse_args(argv)
+
     t0 = time.time()
     import jax
+
+    from cometbft_tpu.libs import tracing
+    from tools import trace_report
 
     results = {}
     for name, fn in [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
@@ -792,11 +810,32 @@ def main():
                      ("cfg6", cfg6_vote_plane),
                      ("cfg7", cfg7_pack_only),
                      ("cfg8", cfg8_multichip_smoke)]:
+        traced = bool(args.trace_out) and name in TRACED_CONFIGS
+        if traced:
+            tracing.enable(capacity=1 << 18)
         try:
             r = fn()
         except Exception as e:  # a config failure must not kill the run
             r = {"metric": f"{name} FAILED", "value": None, "unit": "",
                  "vs_baseline": None, "extra": {"error": repr(e)[:300]}}
+        if traced:
+            try:
+                path = f"{args.trace_out}.{name}.trace.json"
+                doc = tracing.export_chrome()  # one ring snapshot
+                with open(path, "w") as f:
+                    json.dump(doc, f)
+                rep = trace_report.stage_report(doc["traceEvents"])
+                extra = r.setdefault("extra", {})
+                extra["trace_file"] = path
+                extra["trace_stages"] = rep["stages"]
+                if rep["plane"]:
+                    extra["trace_plane"] = rep["plane"]
+            except Exception as e:  # noqa: BLE001 - a bad --trace-out
+                # path must not kill the remaining configs
+                r.setdefault("extra", {})["trace_error"] = repr(e)[:200]
+            finally:
+                # never leak tracing into the untraced configs/headline
+                tracing.disable()
         results[name] = r
         print(json.dumps(r), flush=True)
 

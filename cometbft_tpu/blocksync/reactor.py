@@ -22,6 +22,7 @@ from typing import Callable, List, Optional
 from cometbft_tpu.blocksync.pipeline import CommitJob, StreamVerifier
 from cometbft_tpu.blocksync.pool import BlockPool
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.state.state import State
@@ -81,6 +82,9 @@ class BlocksyncReactor(BaseService):
         self.pool.set_peer_range(peer_id, height, request)
 
     def receive_block(self, peer_id: str, block: Block) -> None:
+        if tracing.enabled():
+            tracing.instant("blocksync.block_received", cat="blocksync",
+                            height=block.header.height, peer=peer_id)
         self.pool.add_block(peer_id, block)
 
     # -- the sync loop -----------------------------------------------------
@@ -148,7 +152,9 @@ class BlocksyncReactor(BaseService):
                 commit=second.last_commit,
                 chain_id=self.state.chain_id,
             ))
-        results = self.verifier.verify(jobs)
+        with tracing.span("blocksync.verify_run", cat="blocksync",
+                          blocks=n, from_height=run[0].header.height):
+            results = self.verifier.verify(jobs)
         # staleness marker: bumps exactly when a validator update lands
         # (state/execution.py _update_state). Once it moves, every
         # remaining job in the run was packed against a stale set and is
@@ -179,10 +185,12 @@ class BlocksyncReactor(BaseService):
             # bugs): punishing the serving peers here would strip an
             # honest node of its sync peers (round-2 advisory). Let the
             # error surface; the run retries without banning.
-            self.block_store.save_block(first, second.last_commit)
-            self.state = self.block_exec.apply_block(
-                self.state, first.block_id(), first
-            )
+            with tracing.span("blocksync.apply", cat="blocksync",
+                              height=first.header.height):
+                self.block_store.save_block(first, second.last_commit)
+                self.state = self.block_exec.apply_block(
+                    self.state, first.block_id(), first
+                )
             self.pool.pop_block()
 
     def _punish_pair(self, height: int) -> None:

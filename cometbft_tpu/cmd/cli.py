@@ -76,7 +76,9 @@ def build_node(home: str, cfg=None):
 
     cfg = cfg or load_config(_config_path(home))
     # arm configured failpoints before any instrumented module runs a
-    # seam (CBT_FAILPOINTS env arming happens lazily regardless)
+    # seam (CBT_FAILPOINTS env arming happens lazily regardless), and
+    # install the tracer first so node assembly itself is traceable
+    cfg.tracing.apply()
     cfg.failpoints.apply()
     cfgdir = os.path.join(home, "config")
     doc = GenesisDoc.from_file(os.path.join(cfgdir, "genesis.json"))

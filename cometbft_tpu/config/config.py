@@ -127,6 +127,34 @@ class VerifyPlaneConfig:
 
 
 @dataclass
+class TracingConfig:
+    """The span/event trace plane (libs/tracing.py). Off by default
+    and near-free while off. `enable = true` installs the global
+    tracer (ring of `buffer` events, served by GET /dump_traces and
+    the dump_traces RPC as perfetto-loadable Chrome trace JSON).
+    `profile_dir` additionally arms the jax.profiler bracket around
+    verify-plane device flights — device traces land in that directory
+    aligned with the host spans (expensive; profiling runs only)."""
+
+    enable: bool = False
+    buffer: int = 16384     # ring capacity, in events
+    profile_dir: str = ""
+
+    def apply(self) -> None:
+        """Symmetric: applying a config with tracing off DISABLES the
+        global tracer and clears the profile dir — rebuilding a node
+        from an edited config must not leave the previous config's
+        tracer (or jax.profiler arming) running."""
+        from cometbft_tpu.libs import tracing
+
+        tracing.set_profile_dir(self.profile_dir)
+        if self.enable:
+            tracing.enable(capacity=self.buffer)
+        else:
+            tracing.disable()
+
+
+@dataclass
 class FailpointsConfig:
     """Deterministic fault injection (libs/failpoints.py). `spec` uses
     the same syntax as the CBT_FAILPOINTS env var:
@@ -152,6 +180,7 @@ class Config:
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     verify_plane: VerifyPlaneConfig = field(
         default_factory=VerifyPlaneConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
 
     def validate_basic(self) -> None:
@@ -175,6 +204,8 @@ class Config:
         if self.verify_plane.max_queue < self.verify_plane.max_batch:
             raise ConfigError(
                 "[verify_plane] max_queue must be >= max_batch")
+        if self.tracing.buffer < 16:
+            raise ConfigError("[tracing] buffer must be >= 16 events")
         if self.failpoints.spec:
             # parse-validate without arming: a typo'd spec must fail at
             # config load, not silently never fire
@@ -205,7 +236,7 @@ def _render(cfg: Config) -> str:
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
-        ("failpoints", cfg.failpoints),
+        ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
     ]:
         out.append(f"[{section}]")
         for k, val in vars(obj).items():
@@ -227,7 +258,7 @@ def load_config(path: str) -> Config:
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
-        ("failpoints", cfg.failpoints),
+        ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
     ]:
         for k, val in doc.get(section, {}).items():
             if not hasattr(obj, k):

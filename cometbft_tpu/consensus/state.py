@@ -21,12 +21,14 @@ import logging
 import queue
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from cometbft_tpu.consensus import wal as walmod
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.consensus.ticker import (
     ManualTicker,
     TimeoutInfo,
@@ -75,6 +77,13 @@ STEP_PREVOTE_WAIT = 5
 STEP_PRECOMMIT = 6
 STEP_PRECOMMIT_WAIT = 7
 STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "new_height", STEP_NEW_ROUND: "new_round",
+    STEP_PROPOSE: "propose", STEP_PREVOTE: "prevote",
+    STEP_PREVOTE_WAIT: "prevote_wait", STEP_PRECOMMIT: "precommit",
+    STEP_PRECOMMIT_WAIT: "precommit_wait", STEP_COMMIT: "commit",
+}
 
 
 @dataclass
@@ -149,6 +158,7 @@ class ConsensusState(BaseService):
         # observability (consensus/metrics.go:24-91 analog); set by Node
         self.metrics = None
         self._last_commit_walltime = 0.0
+        self._step_entered_at = 0.0  # real-clock step-duration anchor
         # set when a SimulatedCrash failpoint killed the machine
         self.crashed = False
 
@@ -216,6 +226,26 @@ class ConsensusState(BaseService):
                 self.on_step_change()
             except Exception:  # noqa: BLE001 - reactor must not kill us
                 _log.exception("on_step_change hook failed")
+
+    def _set_step(self, step: int) -> None:
+        """Every step transition funnels through here: the OUTGOING
+        step's wall duration feeds the per-step histogram (the round
+        breakdown the paper's latency decomposition needs) and the
+        transition lands in the trace. Durations use the real clock
+        even under simnet — the trace timeline rides the trace clock,
+        but step cost is host truth."""
+        now = time.perf_counter()
+        if self.metrics is not None and self._step_entered_at:
+            self.metrics.step_duration.observe(
+                now - self._step_entered_at,
+                step=STEP_NAMES.get(self.step, str(self.step)),
+            )
+        self._step_entered_at = now
+        self.step = step
+        tracing.instant(
+            "consensus.step", cat="consensus", height=self.height,
+            round=self.round, step=STEP_NAMES.get(step, str(step)),
+        )
 
     def proposer_for_round(self, round_: int):
         """The proposer a given round of the current height would elect
@@ -414,7 +444,7 @@ class ConsensusState(BaseService):
                     round_
                 )
         self.round = round_
-        self.step = STEP_NEW_ROUND
+        self._set_step(STEP_NEW_ROUND)
         self._triggered_precommit_wait = False
         if round_ > 0:
             self.proposal = None
@@ -436,7 +466,7 @@ class ConsensusState(BaseService):
 
     def _enter_propose(self, height: int, round_: int) -> None:
         """state.go:1129."""
-        self.step = STEP_PROPOSE
+        self._set_step(STEP_PROPOSE)
         self.ticker.schedule(TimeoutInfo(
             height, round_, STEP_PROPOSE,
             self.timeouts.propose_timeout(round_),
@@ -541,7 +571,7 @@ class ConsensusState(BaseService):
         """state.go:1311."""
         if height != self.height or self.step >= STEP_PREVOTE:
             return
-        self.step = STEP_PREVOTE
+        self._set_step(STEP_PREVOTE)
         self._notify_step()
         self.do_prevote_fn(height, round_)
         self._check_vote_quorums()
@@ -590,7 +620,7 @@ class ConsensusState(BaseService):
         if height != self.height or round_ != self.round \
                 or self.step >= STEP_PREVOTE_WAIT:
             return
-        self.step = STEP_PREVOTE_WAIT
+        self._set_step(STEP_PREVOTE_WAIT)
         self.ticker.schedule(TimeoutInfo(
             height, round_, STEP_PREVOTE_WAIT,
             self.timeouts.prevote_timeout(round_),
@@ -603,7 +633,7 @@ class ConsensusState(BaseService):
         if height != self.height or round_ != self.round \
                 or self.step >= STEP_PRECOMMIT:
             return
-        self.step = STEP_PRECOMMIT
+        self._set_step(STEP_PRECOMMIT)
         self._notify_step()
         maj = self.votes.prevotes(round_).two_thirds_majority()
         if maj is None:
@@ -831,7 +861,7 @@ class ConsensusState(BaseService):
         """state.go:1648."""
         if height != self.height or self.step >= STEP_COMMIT:
             return
-        self.step = STEP_COMMIT
+        self._set_step(STEP_COMMIT)
         self.commit_round = round_
         self._notify_step()
         self._try_finalize_commit(height)
@@ -850,6 +880,12 @@ class ConsensusState(BaseService):
     def _finalize_commit(self, height: int, block_id: BlockID,
                          block: Block) -> None:
         """state.go:1739: persist, apply through ABCI, move to next height."""
+        with tracing.span("consensus.finalize", cat="consensus",
+                          height=height, round=self.commit_round):
+            self._finalize_commit_inner(height, block_id, block)
+
+    def _finalize_commit_inner(self, height: int, block_id: BlockID,
+                               block: Block) -> None:
         fp.fail_point("consensus.pre_finalize")
         precommits = self.votes.precommits(self.commit_round)
         ext_commit = None
@@ -922,9 +958,7 @@ class ConsensusState(BaseService):
         m = self.metrics
         if m is None:
             return
-        import time as _t
-
-        now = _t.monotonic()
+        now = time.monotonic()
         if self._last_commit_walltime:
             m.block_interval.observe(now - self._last_commit_walltime)
         self._last_commit_walltime = now
@@ -943,7 +977,7 @@ class ConsensusState(BaseService):
         """updateToState (state.go:2005) + scheduleRound0."""
         self.height = new_state.last_block_height + 1
         self.round = 0
-        self.step = STEP_NEW_HEIGHT
+        self._set_step(STEP_NEW_HEIGHT)
         self.proposal = None
         self.proposal_block = None
         self.locked_round = -1
@@ -965,8 +999,6 @@ class ConsensusState(BaseService):
 
     def wait_for_height(self, height: int, timeout: float = 30.0) -> bool:
         """Block until the chain reaches `height` (tests/drivers)."""
-        import time
-
         deadline = time.time() + timeout
         while time.time() < deadline:
             if self.state.last_block_height >= height:

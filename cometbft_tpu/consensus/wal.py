@@ -14,15 +14,31 @@ from __future__ import annotations
 import logging
 import os
 import struct
+import threading
+import time
 import zlib
 from dataclasses import dataclass
 from typing import Iterator, Optional, Tuple
 
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 
 _log = logging.getLogger(__name__)
 
 MAX_MSG_SIZE = 1 << 20  # 1MB, wal.go:28
+
+# Process-wide fsync latency accumulator, sampled at scrape time by
+# NodeMetrics (the WAL has no metrics handle — same pattern as the
+# device breaker). Durations use the REAL clock even under simnet:
+# fsync cost is host truth, not simulated time. Locked: multiple WAL
+# instances fsync concurrently in multi-node-in-process tests.
+_FSYNC_STATS = {"count": 0, "seconds": 0.0, "max_seconds": 0.0}
+_FSYNC_LOCK = threading.Lock()
+
+
+def fsync_stats() -> dict:
+    with _FSYNC_LOCK:
+        return dict(_FSYNC_STATS)
 
 # crash-prone seams of the WAL itself (libs/fail call sites of the
 # reference live one layer up in consensus; these cover the file ops)
@@ -136,8 +152,9 @@ class WAL:
         """Write + flush + fsync (wal.go:202 WriteSync) — used for every
         message that must survive a crash before the action it describes
         is taken."""
-        self.write(kind, data)
-        self.flush_and_sync()
+        with tracing.span("wal.write_sync", cat="wal", bytes=len(data)):
+            self.write(kind, data)
+            self.flush_and_sync()
 
     def write_end_height(self, height: int) -> None:
         self.write_sync(END_HEIGHT, struct.pack(">q", height))
@@ -172,7 +189,15 @@ class WAL:
     def flush_and_sync(self) -> None:
         self._f.flush()
         fp.fail_point("wal.pre_fsync")
-        os.fsync(self._f.fileno())
+        t0 = time.perf_counter()
+        with tracing.span("wal.fsync", cat="wal"):
+            os.fsync(self._f.fileno())
+        dt = time.perf_counter() - t0
+        with _FSYNC_LOCK:
+            _FSYNC_STATS["count"] += 1
+            _FSYNC_STATS["seconds"] += dt
+            if dt > _FSYNC_STATS["max_seconds"]:
+                _FSYNC_STATS["max_seconds"] = dt
 
     def close(self) -> None:
         try:

@@ -41,6 +41,7 @@ from cometbft_tpu.crypto.keys import (
     PubKey,
 )
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.libs.staging import StagingPool
 
 _log = logging.getLogger(__name__)
@@ -77,6 +78,7 @@ class CircuitBreaker:
         self._open_until = 0.0
         self._is_open = False
         self.trips = 0        # times the breaker opened (ops counter)
+        self.closes = 0       # open -> closed recoveries
         self.probes = 0       # half-open probes attempted
 
     @property
@@ -103,9 +105,13 @@ class CircuitBreaker:
             was_open = self._is_open
             self._failures = 0
             self._is_open = False
+            if was_open:
+                self.closes += 1
         if was_open:
             _log.warning("circuit breaker %s: device recovered, "
                          "breaker CLOSED", self.name)
+            tracing.instant("breaker.close", cat="crypto",
+                            breaker=self.name)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -123,6 +129,8 @@ class CircuitBreaker:
                 "faults; verifying on the host path, re-probing every "
                 "%.1fs", self.name, self._failures, self.cooldown,
             )
+            tracing.instant("breaker.open", cat="crypto",
+                            breaker=self.name)
 
     def reset(self) -> None:
         with self._lock:
@@ -259,11 +267,13 @@ def verify_batch_direct(
             kernel = (kernels or {}).get(kt) or _kernel_for(kt)
             try:
                 fp.fail_point("crypto.device_dispatch")
-                sub = kernel(
-                    [pubs[i].data for i in idxs],
-                    [msgs[i] for i in idxs],
-                    [sigs[i] for i in idxs],
-                )
+                with tracing.span("crypto.batch.device", cat="crypto",
+                                  key_type=kt, rows=len(idxs)):
+                    sub = kernel(
+                        [pubs[i].data for i in idxs],
+                        [msgs[i] for i in idxs],
+                        [sigs[i] for i in idxs],
+                    )
                 brk.record_success()
             except Exception:  # noqa: BLE001 - device fault, not verdict
                 brk.record_failure()
@@ -273,7 +283,9 @@ def verify_batch_direct(
                 )
                 sub = None
         if sub is None:
-            _host_verify_rows(pubs, msgs, sigs, idxs, valid)
+            with tracing.span("crypto.batch.host", cat="crypto",
+                              key_type=kt, rows=len(idxs)):
+                _host_verify_rows(pubs, msgs, sigs, idxs, valid)
         else:
             valid[np.asarray(idxs)] = np.asarray(sub)
     return valid

@@ -83,6 +83,16 @@ class _Point:
     doc: str = ""
 
 
+def _default_fire_hook(name: str, action: str) -> None:
+    """Every fired failpoint becomes a trace instant: a fault-injection
+    run's trace shows exactly which seam faulted when, interleaved with
+    the consensus/WAL spans it perturbed."""
+    from cometbft_tpu.libs import tracing
+
+    tracing.instant("failpoint.fire", cat="failpoints",
+                    point=name, action=action)
+
+
 @dataclass
 class FailpointRegistry:
     _points: Dict[str, _Point] = field(default_factory=dict)
@@ -90,6 +100,10 @@ class FailpointRegistry:
     _armed: int = 0          # fast-path gate: 0 -> fail_point is a no-op
     _crash: Callable[[str], None] = _default_crash
     _env_loaded: bool = False
+    # fired-point observer (trace/metric hook); None = the module
+    # default (trace instant). swap_registry propagates it so per-node
+    # simnet registries keep tracing through swaps.
+    _fire_hook: Optional[Callable[[str, str], None]] = None
 
     # -- registration ------------------------------------------------------
 
@@ -197,6 +211,10 @@ class FailpointRegistry:
             p.fires += 1
             crash = self._crash
         _log.warning("failpoint FIRED: %s (%s)", name, action)
+        try:
+            (self._fire_hook or _default_fire_hook)(name, action)
+        except Exception:  # noqa: BLE001 - observer must not alter faults
+            pass
         if action == "crash":
             crash(name)
         elif action == "raise" or action == "flake":
@@ -212,6 +230,22 @@ class FailpointRegistry:
             return {"name": p.name, "action": p.action, "arg": p.arg,
                     "remaining": p.remaining, "hits": p.hits,
                     "fires": p.fires}
+
+    def counters(self) -> Dict[str, dict]:
+        """Per-point trigger counts for EVERY registered point — the
+        ops surface /metrics samples this at scrape time (the counts
+        were always tracked; they were just unreachable)."""
+        with self._lock:
+            return {p.name: {"hits": p.hits, "fires": p.fires,
+                             "armed": bool(p.action)}
+                    for p in self._points.values()}
+
+    def set_fire_hook(
+        self, fn: Optional[Callable[[str, str], None]]
+    ) -> None:
+        """Install a fired-point observer (None restores the default
+        trace-instant hook)."""
+        self._fire_hook = fn
 
 
 def parse_spec(spec: str):
@@ -261,7 +295,13 @@ def swap_registry(reg: FailpointRegistry) -> FailpointRegistry:
     OWN failpoint registry: the (single-threaded) scheduler swaps a
     node's registry in around that node's event execution, so a
     ``Failpoint(node=2, ...)`` schedule op faults only node 2's seams.
-    Callers must restore the previous registry (try/finally)."""
+    Callers must restore the previous registry (try/finally).
+
+    Trace/metric hooks survive swaps: registries are swapped as whole
+    objects with their own hooks intact, so the restore direction can
+    never contaminate the original registry with a per-node hook —
+    custom hooks reach per-node registries at :func:`fresh_registry`
+    creation instead."""
     global _REGISTRY
     old = _REGISTRY
     _REGISTRY = reg
@@ -270,9 +310,14 @@ def swap_registry(reg: FailpointRegistry) -> FailpointRegistry:
 
 def fresh_registry(crash_handler=None) -> FailpointRegistry:
     """A standalone registry that never arms from the environment —
-    per-node simnet registries, isolated from CBT_FAILPOINTS."""
+    per-node simnet registries, isolated from CBT_FAILPOINTS. The
+    current global registry's CUSTOM fire hook (if any) is inherited
+    at creation, so trace/metric observation keeps working through
+    registry swaps; the default trace-instant hook needs no
+    inheritance (a None hook already falls back to it)."""
     reg = FailpointRegistry()
     reg._env_loaded = True
+    reg._fire_hook = _REGISTRY._fire_hook
     if crash_handler is not None:
         reg.set_crash_handler(crash_handler)
     return reg
@@ -304,3 +349,7 @@ def arm_from_spec(spec: str) -> int:
 
 def set_crash_handler(fn: Optional[Callable[[str], None]]) -> None:
     _REGISTRY.set_crash_handler(fn)
+
+
+def counters() -> Dict[str, dict]:
+    return _REGISTRY.counters()

@@ -12,10 +12,17 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 
+def _esc_label(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — exposition spec 0.0.4."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -85,9 +92,14 @@ class Gauge(Metric):
 class Histogram(Metric):
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
 
-    def __init__(self, name, help_="", buckets=None):
+    def __init__(self, name, help_="", buckets=None, labeled=False):
         super().__init__(name, help_, "histogram")
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        # labeled=True: every observation carries labels, so no bare
+        # zero series is synthesized while idle — a bare series that
+        # appears at startup and goes stale after the first labeled
+        # observation would confuse absent()-style alerts
+        self.labeled = bool(labeled)
         self._counts: Dict[tuple, List[int]] = {}
         self._sums: Dict[tuple, float] = {}
 
@@ -113,6 +125,14 @@ class Histogram(Metric):
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
+        if not items and not self.labeled:
+            # registered-but-never-observed histograms must still scrape
+            # (zero bucket rows + _sum 0 / _count 0), matching the
+            # zero-value base row Metric.expose emits — an idle plane's
+            # histograms must not vanish from /metrics. Label-only
+            # histograms (labeled=True) stay empty until their first
+            # child series exists, like standard client libraries.
+            items = [((), [0] * (len(self.buckets) + 1))]
         for key, counts in items:
             cum = 0
             for i, ub in enumerate(self.buckets):
@@ -149,8 +169,10 @@ class Registry:
             self._metrics.append(m)
         return m
 
-    def histogram(self, subsystem, name, help_="", buckets=None) -> Histogram:
-        m = Histogram(self._full(subsystem, name), help_, buckets)
+    def histogram(self, subsystem, name, help_="", buckets=None,
+                  labeled=False) -> Histogram:
+        m = Histogram(self._full(subsystem, name), help_, buckets,
+                      labeled=labeled)
         with self._lock:
             self._metrics.append(m)
         return m
@@ -185,10 +207,19 @@ class NodeMetrics:
         )
         self.num_txs = r.gauge("consensus", "num_txs",
                                "Number of transactions in the latest block")
-        self.total_txs = r.counter("consensus", "total_txs",
+        # renamed from total_txs (PR 5): counters end _total
+        # (tools/metrics_lint.py enforces the convention)
+        self.total_txs = r.counter("consensus", "txs_total",
                                    "Total transactions committed")
         self.block_size = r.gauge("consensus", "block_size_bytes",
                                   "Size of the latest block")
+        self.step_duration = r.histogram(
+            "consensus", "step_duration_seconds",
+            "Wall time spent in each consensus step (labeled by the "
+            "step being LEFT)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 3, 10),
+            labeled=True,  # step=... only; no bare idle series
+        )
         # device verifier (TPU-native addition)
         self.verify_batches = r.counter(
             "crypto", "verify_batches_total",
@@ -209,8 +240,10 @@ class NodeMetrics:
         self.plane_queue_depth = r.gauge(
             "verifyplane", "queue_depth",
             "Signature rows pending in the verify plane")
+        # renamed from batch_size (PR 5): histograms carry a base unit
+        # suffix (seconds/bytes/rows) per tools/metrics_lint.py
         self.plane_batch_size = r.histogram(
-            "verifyplane", "batch_size",
+            "verifyplane", "batch_rows",
             "Rows per dispatched verify-plane flush",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
         )
@@ -243,18 +276,116 @@ class NodeMetrics:
         # blocksync
         self.blocksync_syncing = r.gauge("blocksync", "syncing",
                                          "1 while block-syncing")
+        # --- scrape-time sampled internals (PR 5): these subsystems
+        # mutate their counters with no metrics handle in scope, so the
+        # families are registered here and their values are SAMPLED on
+        # every expose_text — /metrics is always current even when the
+        # subsystem has no push path.
+        self.breaker_transitions = r.counter(
+            "crypto", "breaker_transitions_total",
+            "Circuit-breaker state transitions (kind=open|close)")
+        self.breaker_probes = r.counter(
+            "crypto", "breaker_probes_total",
+            "Half-open device probes attempted by the breaker")
+        self.valset_table_cache = r.counter(
+            "crypto", "valset_table_cache_total",
+            "Device-resident valset table cache events "
+            "(ops.ed25519_cached.table_cache_stats, kind-labeled)")
+        self.mesh_step_cache = r.counter(
+            "parallel", "mesh_step_cache_total",
+            "Memoized sharded-step builder cache events "
+            "(parallel.mesh.cache_stats)")
+        self.staging_pool_events = r.counter(
+            "crypto", "staging_pool_total",
+            "Staging-pool buffer requests (kind=hits rotation reuse, "
+            "kind=misses fresh allocations)")
+        self.staging_pool_bytes = r.gauge(
+            "crypto", "staging_pool_resident_bytes",
+            "Host bytes pinned in rotating staging buffers")
+        self.failpoint_hits = r.counter(
+            "failpoints", "hits_total",
+            "Armed-failpoint evaluations, labeled by point")
+        self.failpoint_fires = r.counter(
+            "failpoints", "fires_total",
+            "Failpoint actions actually fired, labeled by point")
+        self.wal_fsync = r.counter(
+            "wal", "fsync_total", "WAL fsyncs completed")
+        self.wal_fsync_seconds = r.counter(
+            "wal", "fsync_seconds_total",
+            "Cumulative WAL fsync wall time")
 
-    def expose_text(self) -> str:
-        # scrape-time refresh: the breaker trips inside
-        # crypto.batch.verify_batch_direct with no metrics handle, so
-        # the gauge is sampled here instead of pushed on state change —
-        # /metrics is always current even with the plane idle/disabled
+    def _sample(self) -> None:
+        """Scrape-time refresh of the push-less internals. Modules that
+        may not be loaded yet (jax-heavy ops/parallel) are only sampled
+        once something imported them — a scrape must never pay a cold
+        jax import. Every group is individually fault-isolated: a sick
+        subsystem costs its own rows, never the whole scrape."""
+        import sys
+
         try:
             from cometbft_tpu.crypto import batch as cbatch
 
-            self.breaker_open.set(
-                1.0 if cbatch.device_breaker().state == "open" else 0.0
-            )
+            brk = cbatch.device_breaker()
+            self.breaker_open.set(1.0 if brk.state == "open" else 0.0)
+            self.breaker_transitions._set((("kind", "open"),),
+                                          float(brk.trips))
+            self.breaker_transitions._set((("kind", "close"),),
+                                          float(brk.closes))
+            self.breaker_probes._set((), float(brk.probes))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
+        try:
+            from cometbft_tpu.crypto import batch as cbatch
+
+            st = cbatch.staging_pool().stats()
+            pools = [st]
+            vp = sys.modules.get("cometbft_tpu.verifyplane.plane")
+            if vp is not None and vp._GLOBAL is not None:
+                pools.append(vp._GLOBAL._staging.stats())
+            self.staging_pool_events._set(
+                (("kind", "hits"),),
+                float(sum(p["hits"] for p in pools)))
+            self.staging_pool_events._set(
+                (("kind", "misses"),),
+                float(sum(p["misses"] for p in pools)))
+            self.staging_pool_bytes.set(
+                float(sum(p["resident_bytes"] for p in pools)))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            ec = sys.modules.get("cometbft_tpu.ops.ed25519_cached")
+            if ec is not None:
+                for kind, v in ec.table_cache_stats().items():
+                    self.valset_table_cache._set((("kind", kind),),
+                                                 float(v))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            pm = sys.modules.get("cometbft_tpu.parallel.mesh")
+            if pm is not None:
+                for kind, v in pm.cache_stats().items():
+                    self.mesh_step_cache._set((("kind", kind),), float(v))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from cometbft_tpu.libs import failpoints as fp
+
+            for name, c in fp.registry().counters().items():
+                if c["hits"] or c["fires"]:
+                    key = (("point", name),)
+                    self.failpoint_hits._set(key, float(c["hits"]))
+                    self.failpoint_fires._set(key, float(c["fires"]))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from cometbft_tpu.consensus import wal as walmod
+
+            fs = walmod.fsync_stats()
+            self.wal_fsync._set((), float(fs["count"]))
+            self.wal_fsync_seconds._set((), float(fs["seconds"]))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def expose_text(self) -> str:
+        self._sample()
         return self.registry.expose_text()

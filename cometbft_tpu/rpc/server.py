@@ -539,6 +539,17 @@ class Routes:
         self.node.mempool.flush()
         return {}
 
+    # -- tracing (libs/tracing.py; also served as GET /dump_traces) ---------
+
+    def dump_traces(self):
+        """The current trace ring as Chrome trace-event JSON (empty
+        when tracing is disabled). Save the result to a file and load
+        it in https://ui.perfetto.dev — or curl the /dump_traces GET
+        path, which serves the document directly."""
+        from cometbft_tpu.libs import tracing
+
+        return tracing.export_chrome()
+
 
 _ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
@@ -548,7 +559,7 @@ _ROUTES = [
     "abci_info", "abci_query", "check_tx", "broadcast_evidence",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
-    "block_search",
+    "block_search", "dump_traces",
 ]
 
 # only served when the server runs with unsafe=True
@@ -653,6 +664,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if url.path == "/dump_traces":
+            # perfetto-loadable Chrome trace JSON of the current ring
+            # (node/node.go:846's prometheus sibling, for spans)
+            from cometbft_tpu.libs import tracing
+
+            body = json.dumps(tracing.export_chrome()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if url.path.startswith("/debug/pprof"):
             # profiling endpoints (node/node.go:867-881 pprof server +
             # rpc/core/dev.go unsafe profiling): Python analogs —
@@ -710,7 +733,9 @@ class _Handler(BaseHTTPRequestHandler):
                     if tid == me:
                         continue
                     co = fr.f_code
-                    samples[f"{co.co_qualname} "
+                    # co_qualname is 3.11+; this image runs 3.10
+                    qn = getattr(co, "co_qualname", co.co_name)
+                    samples[f"{qn} "
                             f"({co.co_filename.rsplit('/', 1)[-1]}:"
                             f"{fr.f_lineno})"] += 1
                 nsamp += 1

@@ -66,6 +66,7 @@ from cometbft_tpu.consensus.state import ProposalMsg
 from cometbft_tpu.consensus.ticker import TimeoutInfo
 from cometbft_tpu.evidence.reactor import EVIDENCE_CHANNEL
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.types import serde
 from cometbft_tpu.types.evidence import (
     EvidenceError,
@@ -266,6 +267,7 @@ class SimNode:
         if not self.alive:
             return
         _log.warning("simnet node %d halted: %s", self.idx, reason)
+        tracing.instant("simnet.halt", cat="simnet", node=self.idx)
         self._record_commits()
         self.alive = False
         self.crashed = True
@@ -290,6 +292,7 @@ class SimNode:
         mid-simulation."""
         assert not self.alive, "restart of a live node"
         self.restarts += 1
+        tracing.instant("simnet.restart", cat="simnet", node=self.idx)
         self.start()
         self.connect_full_mesh()
         for other in self.net.nodes:
@@ -470,11 +473,17 @@ class SimNetwork:
     def _install_clock(self) -> None:
         if not self._clock_installed:
             set_now_source(self._sim_now)
+            # traces run on the virtual clock too: every span/instant
+            # timestamp is Timestamp.now().to_ns() = a deterministic
+            # function of the schedule, so the same (seed, schedule)
+            # exports an IDENTICAL trace
+            tracing.set_clock(lambda: Timestamp.now().to_ns())
             self._clock_installed = True
 
     def _uninstall_clock(self) -> None:
         if self._clock_installed:
             set_now_source(None)
+            tracing.set_clock(None)
             self._clock_installed = False
 
     def schedule(self, delay: float, fn: Callable[[], None],

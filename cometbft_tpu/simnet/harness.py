@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.simnet import actors
 from cometbft_tpu.simnet.core import EVIDENCE_CHANNEL, SimNetwork
 from cometbft_tpu.simnet.schedule import (
@@ -30,9 +31,14 @@ class SimnetFailure(AssertionError):
     def __init__(self, msg: str, seed: int, schedule: List[Dict]):
         self.seed = seed
         self.schedule = schedule
-        super().__init__(
-            f"{msg}\nreplay: {schedule_to_json(seed, schedule)}"
-        )
+        text = f"{msg}\nreplay: {schedule_to_json(seed, schedule)}"
+        # when tracing is on, the tail of the span/event ring rides the
+        # failure: the last thing the simulation did before wedging,
+        # in order, on the virtual clock
+        trace_tail = tracing.tail(40)
+        if trace_tail:
+            text += "\ntrace tail: " + " ".join(trace_tail)
+        super().__init__(text)
 
 
 class Simnet:
@@ -87,6 +93,11 @@ class Simnet:
     def _apply(self, op: Dict) -> None:
         net = self.net
         kind = op["op"]
+        # every fault-schedule op becomes a trace instant, so a trace
+        # of a wedged run shows the perturbation timeline inline with
+        # the consensus/WAL spans it perturbed
+        tracing.instant("simnet.op", cat="simnet", op=kind,
+                        at=float(op["at"]))
         if kind == "partition":
             groups = [set(g) for g in op["groups"]]
             group_of = {}

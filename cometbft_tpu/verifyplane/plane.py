@@ -37,6 +37,7 @@ inline host path — futures always resolve, submitters never hang.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -46,6 +47,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
 
 _log = logging.getLogger(__name__)
 
@@ -55,6 +57,12 @@ fp.register("verifyplane.dispatch",
             "still resolve)")
 
 DISPATCH_LOG_MAX = 64       # flush-composition ring kept for tests/ops
+
+# Process-global flush ids: flight b/e trace events pair by (name, cat,
+# id), so two planes alive in one process (multi-node tests, simnet)
+# must never reuse an id — perfetto and trace_report would pair plane
+# A's begin with plane B's end. next() on itertools.count is atomic.
+_FLUSH_IDS = itertools.count()
 DEFAULT_RESULT_TIMEOUT = 30.0
 # stop()-time leftover drain budget: rows host-verified synchronously
 # before remaining futures fail fast (a few seconds worst-case on the
@@ -167,7 +175,7 @@ class QuorumGroup:
 
 class _Submission:
     __slots__ = ("rows", "future", "group", "power", "counted",
-                 "vidx", "t_submit", "tid")
+                 "vidx", "t_submit", "t_submit_trace", "tid")
 
     def __init__(self, rows, group, power, counted, vidx=None):
         self.rows = rows                      # [(PubKey, msg, sig), ...]
@@ -177,6 +185,10 @@ class _Submission:
         self.counted = bool(counted)
         self.vidx = tuple(vidx) if vidx is not None else None
         self.t_submit = time.perf_counter()
+        # trace-clock stamp for the pack span's queued_ms: rides the
+        # TRACE clock (virtual under simnet) so traces of the same
+        # (seed, schedule) stay byte-identical; None when tracing off
+        self.t_submit_trace = tracing.clock_ns()
         self.tid = threading.get_ident()
 
 
@@ -345,6 +357,9 @@ class VerifyPlane:
             if self.metrics is not None:
                 self.metrics.plane_queue_depth.set(self._pending_rows)
             self._cv.notify_all()
+        if tracing.enabled():
+            tracing.instant("plane.submit", cat="verifyplane",
+                            rows=len(rows), depth=self._pending_rows)
         return sub.future
 
     def submit_and_wait(self, pubs, msgs, sigs,
@@ -370,7 +385,7 @@ class VerifyPlane:
         flush already in flight the window wait is skipped: the
         in-flight pass IS the coalescing amortization the window
         exists to provide."""
-        inflight = None  # airborne (batch, finish, True) device flight
+        inflight = None  # airborne (batch, finish, True, flush_id)
         while True:
             batch: List[_Submission] = []
             with self._cv:
@@ -423,9 +438,20 @@ class VerifyPlane:
             self._finish_flight(inflight)
 
     def _finish_flight(self, flight) -> None:
-        batch, finish, _airborne = flight
-        verdicts, fused_tallies = finish()
-        self._settle(batch, verdicts, fused_tallies=fused_tallies)
+        batch, finish, airborne, fid = flight
+        if airborne:
+            with tracing.span("plane.collect", cat="verifyplane",
+                              flush=fid):
+                verdicts, fused_tallies = finish()
+            tracing.flight_end("plane.flight", fid, cat="verifyplane")
+        else:
+            # synchronous flush: the deferred host/grouped verification
+            # happens here, attributed to its own stage
+            with tracing.span("plane.verify", cat="verifyplane",
+                              flush=fid):
+                verdicts, fused_tallies = finish()
+        with tracing.span("plane.settle", cat="verifyplane", flush=fid):
+            self._settle(batch, verdicts, fused_tallies=fused_tallies)
 
     def _observe_pack(self, seconds: float, h2d_bytes: int = 0) -> None:
         self.pack_seconds += seconds
@@ -437,11 +463,31 @@ class VerifyPlane:
 
     def _stage(self, batch: List[_Submission]):
         """Pack one flush and (when eligible) launch it on the device
-        WITHOUT waiting for results. Returns (batch, finish) where
-        finish() blocks for the verdicts — the seam that lets the
-        dispatcher pack the next flush while this one flies.
+        WITHOUT waiting for results. Returns (batch, finish, airborne,
+        flush_id) where finish() blocks for the verdicts — the seam
+        that lets the dispatcher pack the next flush while this one
+        flies. The whole host-side staging is one "plane.pack" trace
+        span keyed by flush id, so pack(k+1) visibly overlaps
+        device-flight(k) in the exported timeline."""
+        fid = next(_FLUSH_IDS)
+        if not tracing.enabled():
+            # disabled fast path: no O(batch) span-arg computation on
+            # the dispatcher hot path
+            batch, finish, airborne = self._stage_inner(batch, fid)
+            return batch, finish, airborne, fid
+        now_ns = tracing.clock_ns()
+        stamps = [s.t_submit_trace for s in batch
+                  if s.t_submit_trace is not None]
+        args = {"flush": fid, "rows": sum(len(s.rows) for s in batch),
+                "subs": len(batch)}
+        if stamps and now_ns is not None:
+            args["queued_ms"] = round((now_ns - min(stamps)) / 1e6, 3)
+        with tracing.span("plane.pack", cat="verifyplane", **args):
+            batch, finish, airborne = self._stage_inner(batch, fid)
+        return batch, finish, airborne, fid
 
-        The breaker's allow() — which consumes the single half-open
+    def _stage_inner(self, batch: List[_Submission], fid: int):
+        """The breaker's allow() — which consumes the single half-open
         probe slot when the breaker is open — is only asked once a
         fused plan exists, i.e. when a device attempt will actually
         happen; an ineligible flush must not burn the probe the
@@ -455,8 +501,10 @@ class VerifyPlane:
                 "verify plane dispatch fault (%d rows); degrading this "
                 "flush to the inline host path", len(rows),
             )
-            verdicts = _host_verdicts(rows)
-            return batch, (lambda: (verdicts, None)), False
+            # verdict work is deferred into finish() so the pack span
+            # measures staging only (the finish runs immediately for
+            # synchronous flushes — same thread, same ordering)
+            return batch, (lambda: (_host_verdicts(rows), None)), False
         plan = None
         if self._use_device and self._kernels is None:
             from cometbft_tpu.verifyplane import fused as fz
@@ -470,7 +518,14 @@ class VerifyPlane:
                 plan = None
         if plan is not None:
             try:
+                # [tracing] profile_dir: bracket the device flight with
+                # a jax.profiler capture so device traces line up with
+                # the host spans (no-op unless configured)
+                prof = tracing.profiler_stop if tracing.profiler_start() \
+                    else None
                 fz.dispatch_fused(plan)
+                tracing.flight_begin("plane.flight", fid,
+                                     cat="verifyplane", rows=len(rows))
                 self._observe_pack(time.perf_counter() - t0,
                                    fz.plan_h2d_bytes(plan))
 
@@ -484,19 +539,26 @@ class VerifyPlane:
                             "host fallback for this flush"
                         )
                         return _host_verdicts(rows), None
+                    finally:
+                        if prof is not None:
+                            prof()
                     self._breaker.record_success()
                     return out
 
                 return batch, finish, True
             except Exception:  # noqa: BLE001 - device fault at dispatch
+                if prof is not None:
+                    prof()  # un-bracket a failed dispatch
                 self._breaker.record_failure()
                 _log.exception(
                     "fused verify-plane dispatch failed; falling back "
                     "to the grouped path"
                 )
         self._observe_pack(time.perf_counter() - t0)
-        verdicts = self._verify_rows(rows)
-        return batch, (lambda: (verdicts, None)), False
+        # deferred like the failpoint arm: pack_seconds (and the
+        # plane.pack span) cover staging; the host/grouped verify runs
+        # inside finish() under its own plane.verify span
+        return batch, (lambda: (self._verify_rows(rows), None)), False
 
     def _verify_rows(self, rows) -> List[bool]:
         """One padded device pass under the circuit breaker, or the

@@ -138,3 +138,73 @@ def test_config_spec_validation():
     cfg.failpoints.spec = "wal.pre_fsync=explode"
     with pytest.raises(ConfigError):
         cfg.validate_basic()
+
+
+def test_counters_surface_every_point():
+    """ISSUE 5 satellite: per-point trigger counts are reachable from
+    the registry (they were tracked but unreachable from /metrics)."""
+    fp.register("t.counted", "doc")
+    fp.arm("t.counted", "raise", count=1)
+    with pytest.raises(fp.FailpointError):
+        fp.fail_point("t.counted")
+    fp.fail_point("t.counted")  # self-disarmed: hit not counted armed
+    c = fp.counters()
+    assert c["t.counted"]["hits"] == 1
+    assert c["t.counted"]["fires"] == 1
+    assert c["t.counted"]["armed"] is False
+    # unarmed registered points appear too (zero rows)
+    assert "wal.pre_fsync" in c
+
+
+def test_fired_points_emit_trace_instants():
+    from cometbft_tpu.libs import tracing
+
+    tracing.enable(capacity=32)
+    try:
+        fp.arm("t.traced", "raise", count=1)
+        with pytest.raises(fp.FailpointError):
+            fp.fail_point("t.traced")
+        evs = tracing.export_chrome()["traceEvents"]
+        fires = [e for e in evs if e["name"] == "failpoint.fire"]
+        assert fires and fires[0]["args"] == {"point": "t.traced",
+                                              "action": "raise"}
+    finally:
+        tracing.disable()
+
+
+def test_registry_swap_keeps_fire_hooks_intact():
+    """ISSUE 5 satellite: trace/metric hooks survive registry swaps —
+    a per-node fresh_registry inherits the current custom fire hook at
+    creation (the simnet's shape), and restoring the original registry
+    leaves its own hooks exactly as they were: a node-local hook can
+    never contaminate the restored global."""
+    seen = []
+    fp.registry().set_fire_hook(lambda n, a: seen.append((n, a)))
+    try:
+        node_reg = fp.fresh_registry(fp.simulated_crash)
+        old = fp.swap_registry(node_reg)
+        try:
+            assert node_reg._fire_hook is old._fire_hook
+            fp.arm("n.point", "raise", count=1)
+            with pytest.raises(fp.FailpointError):
+                fp.fail_point("n.point")
+        finally:
+            restored = fp.swap_registry(old)
+            assert restored is node_reg
+        # the hook observed the swapped-in registry's fire...
+        assert seen == [("n.point", "raise")]
+        # ...and still observes the restored original
+        fp.arm("t.after", "raise", count=1)
+        with pytest.raises(fp.FailpointError):
+            fp.fail_point("t.after")
+        assert seen[-1] == ("t.after", "raise")
+    finally:
+        fp.registry().set_fire_hook(None)
+    # restore direction never contaminates: a node registry that grew
+    # its OWN hook must not leave it on the global after swap-back
+    node_reg = fp.fresh_registry(fp.simulated_crash)
+    node_hook = lambda n, a: None  # noqa: E731
+    node_reg.set_fire_hook(node_hook)
+    old = fp.swap_registry(node_reg)
+    assert fp.swap_registry(old) is node_reg
+    assert fp.registry()._fire_hook is not node_hook

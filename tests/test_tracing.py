@@ -1,0 +1,313 @@
+"""The trace plane (libs/tracing.py): tracer semantics, Chrome-trace
+export, the /dump_traces surface, trace_report's stage table, and the
+simnet trace-determinism acceptance (same seed+schedule => identical
+span names/order/timestamps under the virtual clock).
+"""
+import json
+
+import pytest
+
+from cometbft_tpu.libs import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    tracing.disable()
+    tracing.set_clock(None)
+    yield
+    tracing.disable()
+    tracing.set_clock(None)
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_noop():
+    assert not tracing.enabled()
+    with tracing.span("never", cat="x", k=1) as s:
+        assert s is None
+    tracing.instant("never")
+    tracing.flight_begin("never", 1)
+    tracing.flight_end("never", 1)
+    assert tracing.export_chrome()["traceEvents"] == []
+    assert tracing.tail() == []
+
+
+def test_span_instant_flight_export():
+    tracing.enable(capacity=128)
+    with tracing.span("outer", cat="t", height=3):
+        tracing.instant("mark", cat="t", n=1)
+        with tracing.span("inner", cat="t"):
+            pass
+    tracing.flight_begin("fly", 7, cat="t", rows=4)
+    tracing.flight_end("fly", 7, cat="t")
+    evs = tracing.export_chrome()["traceEvents"]
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["outer"]["ph"] == "X"
+    assert by_name["outer"]["args"] == {"height": 3}
+    assert by_name["outer"]["dur"] >= by_name["inner"]["dur"] >= 0
+    assert by_name["mark"]["ph"] == "i" and by_name["mark"]["s"] == "t"
+    # async pair correlated by id, required for perfetto overlap tracks
+    b = [e for e in evs if e["ph"] == "b"][0]
+    e = [e for e in evs if e["ph"] == "e"][0]
+    assert b["id"] == e["id"] == "7"
+    assert b["cat"] == e["cat"] == "t"
+    # inner closed before outer: ring order is completion order
+    names = [ev["name"] for ev in evs]
+    assert names.index("inner") < names.index("outer")
+    # the whole document is valid JSON with the chrome keys
+    doc = json.loads(json.dumps(tracing.export_chrome()))
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_ring_buffer_bounds_and_drop_count():
+    t = tracing.enable(capacity=16)
+    for i in range(40):
+        tracing.instant(f"e{i}")
+    evs = t.events()
+    assert len(evs) == 16
+    assert evs[0]["name"] == "e24" and evs[-1]["name"] == "e39"
+    assert t.dropped == 24
+
+
+def test_deterministic_mode_and_custom_clock():
+    ticks = iter(range(0, 10_000, 1000))
+    tracing.enable(capacity=32, clock=lambda: next(ticks),
+                   deterministic=True)
+    with tracing.span("a"):
+        tracing.instant("b")
+    evs = tracing.export_chrome()["traceEvents"]
+    assert all(e["tid"] == 0 and e["pid"] == 1 for e in evs)
+    assert [e["ts"] for e in evs] == [1.0, 0.0]  # ns -> us
+    assert evs[1]["dur"] == 2.0  # span a: t0=0, closed at t=2000ns
+
+
+def test_write_and_tail(tmp_path):
+    tracing.enable(capacity=32)
+    tracing.instant("alpha")
+    with tracing.span("beta"):
+        pass
+    path = str(tmp_path / "trace.json")
+    tracing.write(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert [e["name"] for e in doc["traceEvents"]] == ["alpha", "beta"]
+    assert tracing.tail(1) == ["beta(X)"]
+
+
+def test_profiler_bracket_noop_without_dir():
+    tracing.set_profile_dir("")
+    assert tracing.profiler_start() is False
+    tracing.profiler_stop()  # must not raise
+
+
+def test_dump_traces_route():
+    from cometbft_tpu.rpc.server import Routes
+
+    tracing.enable(capacity=32)
+    tracing.instant("rpc-visible")
+    doc = Routes(None).dump_traces()
+    assert doc["traceEvents"][0]["name"] == "rpc-visible"
+
+
+def test_tracing_config_applies():
+    from cometbft_tpu.config.config import Config, ConfigError
+
+    cfg = Config()
+    assert cfg.tracing.enable is False
+    cfg.tracing.enable = True
+    cfg.tracing.buffer = 64
+    cfg.validate_basic()
+    cfg.tracing.apply()
+    assert tracing.enabled() and tracing.tracer().capacity == 64
+    cfg.tracing.buffer = 1
+    with pytest.raises(ConfigError, match="tracing"):
+        cfg.validate_basic()
+
+
+# ---------------------------------------------------------------------------
+# instrumented seams produce spans
+# ---------------------------------------------------------------------------
+
+
+def test_wal_spans_and_fsync_stats(tmp_path):
+    from cometbft_tpu.consensus import wal as walmod
+
+    tracing.enable(capacity=64)
+    before = walmod.fsync_stats()
+    w = walmod.WAL(str(tmp_path / "t.wal"))
+    w.write_sync(walmod.MSG_INFO, b"payload")
+    w.close()
+    after = walmod.fsync_stats()
+    assert after["count"] >= before["count"] + 1
+    assert after["seconds"] >= before["seconds"]
+    names = [e["name"] for e in tracing.export_chrome()["traceEvents"]]
+    assert "wal.fsync" in names and "wal.write_sync" in names
+
+
+def test_plane_flush_lifecycle_spans():
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import VerifyPlane
+
+    tracing.enable(capacity=256)
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    try:
+        priv = PrivKey.generate(b"\x61" * 32)
+        msg = b"traced-vote"
+        fut = plane.submit(priv.pub_key(), msg, priv.sign(msg))
+        assert fut.result(10.0) == (True,)
+    finally:
+        plane.stop()
+    evs = tracing.export_chrome()["traceEvents"]
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "plane.submit" in by_name
+    packs = by_name["plane.pack"]
+    settles = by_name["plane.settle"]
+    assert packs and settles
+    # pack and settle of one flush correlate by flush id (ids are
+    # process-global so concurrent planes can never cross-pair flights)
+    assert packs[0]["args"]["flush"] == settles[0]["args"]["flush"]
+    assert packs[0]["args"]["rows"] == 1
+    assert packs[0]["args"]["queued_ms"] >= 0
+
+
+def test_consensus_step_metrics_and_instants(tmp_path):
+    """A live single-validator node emits consensus.step instants and
+    per-step duration observations while committing blocks."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.consensus.ticker import TimeoutParams
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    tracing.enable(capacity=4096)
+    fast = TimeoutParams(propose=0.4, propose_delta=0.1, prevote=0.2,
+                         prevote_delta=0.1, precommit=0.2,
+                         precommit_delta=0.1, commit=0.01)
+    priv = PrivKey.generate(bytes([29]) * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    state = State.make_genesis("trace-chain", vals)
+    node = Node(KVStoreApplication(), state, privval=FilePV(priv),
+                home=str(tmp_path / "n0"), timeouts=fast)
+    node.start()
+    try:
+        assert node.consensus.wait_for_height(2, timeout=30)
+        text = node.metrics.expose_text()
+    finally:
+        node.stop()
+    steps = [e for e in tracing.export_chrome()["traceEvents"]
+             if e["name"] == "consensus.step"]
+    seen = {e["args"]["step"] for e in steps}
+    assert {"propose", "prevote", "precommit", "commit"} <= seen
+    # per-step durations landed in the labeled histogram
+    assert 'cometbft_consensus_step_duration_seconds_count' \
+        '{step="propose"}' in text
+
+
+# ---------------------------------------------------------------------------
+# trace_report
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_stage_table(tmp_path):
+    from tools import trace_report
+
+    clock = iter(range(0, 10_000_000, 500_000))  # 0.5 ms ticks
+    tracing.enable(capacity=256, clock=lambda: next(clock),
+                   deterministic=True)
+    # flush 0 flies while flush 1 packs: pack(1) must show overlap
+    tracing.flight_begin("plane.flight", 0, cat="verifyplane")
+    with tracing.span("plane.pack", cat="verifyplane", flush=1):
+        pass
+    tracing.flight_end("plane.flight", 0, cat="verifyplane")
+    with tracing.span("plane.collect", cat="verifyplane", flush=0):
+        pass
+    tracing.instant("simnet.op", cat="simnet", op="heal")
+    path = str(tmp_path / "t.json")
+    tracing.write(path)
+
+    rep = trace_report.stage_report(trace_report.load(path))
+    stages = {r["stage"]: r for r in rep["stages"]}
+    assert stages["plane.pack"]["count"] == 1
+    assert stages["plane.pack"]["total_ms"] == pytest.approx(0.5)
+    assert stages["plane.collect"]["count"] == 1
+    # plane pipeline order leads the table
+    assert rep["stages"][0]["stage"] == "plane.pack"
+    assert rep["plane"]["flights"] == 1
+    # flight: begin tick 0 -> end tick 3 = 1.5 ms on the 0.5 ms clock
+    assert rep["plane"]["flight_total_ms"] == pytest.approx(1.5)
+    # the whole pack happened while flight 0 was airborne
+    assert rep["plane"]["pack_overlap_frac"] == pytest.approx(1.0)
+    assert rep["instants"] == {"simnet.op": 1}
+    txt = trace_report.format_report(rep)
+    assert "plane.pack" in txt and "verify-plane flights: 1" in txt
+
+
+def test_trace_report_cli(tmp_path, capsys):
+    from tools import trace_report
+
+    tracing.enable(capacity=16)
+    with tracing.span("stage.a"):
+        pass
+    path = str(tmp_path / "t.json")
+    tracing.write(path)
+    assert trace_report.main([path]) == 0
+    assert "stage.a" in capsys.readouterr().out
+    assert trace_report.main([path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["stages"][0]["stage"] == "stage.a"
+
+
+# ---------------------------------------------------------------------------
+# simnet determinism (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+TRACE_SCHEDULE = [
+    {"at": 0.1, "op": "link", "drop": 0.05, "delay": 0.02},
+    {"at": 0.8, "op": "heal"},
+]
+
+
+@pytest.mark.simnet
+def test_simnet_trace_byte_identical(tmp_path):
+    """Same (seed, schedule) twice => the exported trace is
+    BYTE-identical: every span/instant name, order, argument, and
+    virtual-clock timestamp matches. This is what makes a trace of a
+    wedged schedule replayable evidence. (Budgeted small for tier-1:
+    3 nodes, 2 heights — the trace shape, not the fault coverage,
+    is under test; test_simnet owns the scenario matrix.)"""
+    from cometbft_tpu.simnet import Simnet
+
+    def run_once(tag):
+        tracing.enable(capacity=1 << 15, deterministic=True)
+        try:
+            with Simnet(3, seed=42, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(TRACE_SCHEDULE, until_height=2,
+                               max_time=60.0)
+                sim.assert_safety()
+            return json.dumps(tracing.export_chrome(), sort_keys=True)
+        finally:
+            tracing.disable()
+
+    a = run_once("a")
+    b = run_once("b")
+    assert a == b
+    evs = json.loads(a)["traceEvents"]
+    names = {e["name"] for e in evs}
+    # the run actually traced the layers that matter
+    assert "consensus.step" in names
+    assert "wal.fsync" in names
+    assert "simnet.op" in names
+    # timestamps ride the VIRTUAL clock: they live inside the sim's
+    # epoch (seconds around SIM_EPOCH_SECONDS, expressed in us)
+    from cometbft_tpu.simnet.core import SIM_EPOCH_SECONDS
+
+    ts0 = min(e["ts"] for e in evs)
+    assert ts0 >= SIM_EPOCH_SECONDS * 1e6

@@ -465,7 +465,7 @@ def test_plane_metrics_exposed(plane):
     text = m.expose_text()
     for name in (
         "cometbft_verifyplane_queue_depth",
-        "cometbft_verifyplane_batch_size",
+        "cometbft_verifyplane_batch_rows",
         "cometbft_verifyplane_submit_to_result_seconds",
         "cometbft_verifyplane_padding_waste_total",
         "cometbft_verifyplane_pack_seconds",
@@ -474,7 +474,7 @@ def test_plane_metrics_exposed(plane):
     ):
         assert name in text, name
     # the flush recorded a batch and a latency observation
-    assert "cometbft_verifyplane_batch_size_count" in text
+    assert "cometbft_verifyplane_batch_rows_count" in text
 
 
 def test_plane_pack_metrics_and_overlap_counters(plane):

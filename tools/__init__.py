@@ -1,0 +1,2 @@
+"""Operator/CI tooling (runnable scripts; importable from the repo
+root for bench.py and the test suite)."""

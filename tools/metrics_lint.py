@@ -1,0 +1,75 @@
+"""Metric naming-convention lint, wired into a fast tier-1 test.
+
+Prometheus conventions this build commits to (and the lint enforces
+over the REGISTERED metric set, so a drive-by metric addition fails CI
+before it ships an unscrapable name):
+
+  * counters end in ``_total``;
+  * histograms carry a base-unit suffix: ``_seconds``, ``_bytes``, or
+    ``_rows`` (the one dimensionless unit this system measures);
+  * gauges must NOT end in ``_total`` (that suffix promises a counter);
+  * no duplicate metric names in one registry (duplicate families
+    render /metrics unparseable);
+  * every metric has non-empty HELP text.
+
+Usage:
+    python tools/metrics_lint.py   # lint NodeMetrics; exit 1 on violations
+"""
+from __future__ import annotations
+
+from typing import List
+
+HISTOGRAM_UNITS = ("_seconds", "_bytes", "_rows")
+
+
+def lint_registry(registry) -> List[str]:
+    """Violations for every metric registered in a libs.metrics
+    Registry; empty list = clean."""
+    out: List[str] = []
+    seen = set()
+    with registry._lock:
+        metrics = list(registry._metrics)
+    for m in metrics:
+        if m.name in seen:
+            out.append(f"duplicate registration: {m.name}")
+        seen.add(m.name)
+        if not m.help:
+            out.append(f"{m.name}: empty HELP text")
+        if m.type == "counter" and not m.name.endswith("_total"):
+            out.append(f"{m.name}: counter must end _total")
+        if m.type == "gauge" and m.name.endswith("_total"):
+            out.append(f"{m.name}: gauge must not end _total")
+        if m.type == "histogram" and \
+                not m.name.endswith(HISTOGRAM_UNITS):
+            out.append(
+                f"{m.name}: histogram must carry a base unit suffix "
+                f"{HISTOGRAM_UNITS}"
+            )
+    return out
+
+
+def lint_node_metrics() -> List[str]:
+    """Lint the full node metric set (the registry every node serves)."""
+    from cometbft_tpu.libs.metrics import NodeMetrics
+
+    return lint_registry(NodeMetrics().registry)
+
+
+def main() -> int:
+    violations = lint_node_metrics()
+    for v in violations:
+        print(f"metrics-lint: {v}")
+    if not violations:
+        print("metrics-lint: NodeMetrics clean")
+    return min(len(violations), 1)
+
+
+if __name__ == "__main__":
+    # direct script invocation puts tools/ on sys.path, not the repo
+    # root — bootstrap it so `from cometbft_tpu...` resolves
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    raise SystemExit(main())

@@ -1,0 +1,153 @@
+"""Turn a Chrome trace file (libs/tracing.py export) into a per-stage
+critical-path table.
+
+The perf loop's before/after instrument: run a workload with tracing on
+(``bench.py --trace-out``, ``[tracing] enable``, or
+``curl $NODE/dump_traces``), feed the file here, and read where the
+wall time went per stage — pack vs device flight vs collect vs settle
+for the verify plane, per-step time for consensus, fsync cost for the
+WAL. BENCH_*.json embeds the same table via ``stage_report``.
+
+Usage:
+    python tools/trace_report.py trace.json [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional
+
+# verify-plane flush pipeline, in submission order: the critical-path
+# section reports these stages first and computes pack/flight overlap
+PLANE_STAGES = ("plane.pack", "plane.flight", "plane.collect",
+                "plane.settle")
+
+
+def load(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def _pct(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    i = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[i]
+
+
+def _flight_intervals(events: List[dict]) -> List[tuple]:
+    """(ts_begin, ts_end) per async flight id, from b/e event pairs."""
+    begun: Dict[str, float] = {}
+    out = []
+    for e in events:
+        if e.get("ph") == "b":
+            begun[e.get("id", "")] = e["ts"]
+        elif e.get("ph") == "e":
+            t0 = begun.pop(e.get("id", ""), None)
+            if t0 is not None:
+                out.append((t0, e["ts"]))
+    return out
+
+
+def _overlap_us(span: tuple, intervals: List[tuple]) -> float:
+    lo, hi = span
+    return sum(max(0.0, min(hi, b) - max(lo, a))
+               for a, b in intervals if b > lo and a < hi)
+
+
+def stage_report(events: List[dict]) -> dict:
+    """Aggregate a trace into {stages, instants, plane} — the table the
+    bench embeds and main() pretty-prints.
+
+    stages: per span name, count + total/mean/p50/max ms.
+    instants: per instant name, count.
+    plane: flush-pipeline extras — flight count/total from the async
+    b/e pairs and the fraction of pack time hidden behind an airborne
+    flight (the double-buffer overlap the dispatcher exists to win).
+    """
+    spans: Dict[str, List[float]] = {}
+    instants: Dict[str, int] = {}
+    pack_spans = []
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            spans.setdefault(e["name"], []).append(e.get("dur", 0.0))
+            if e["name"] == "plane.pack":
+                pack_spans.append((e["ts"], e["ts"] + e.get("dur", 0.0)))
+        elif ph == "i":
+            instants[e["name"]] = instants.get(e["name"], 0) + 1
+    flights = _flight_intervals(events)
+
+    def row(name: str, durs: List[float]) -> dict:
+        return {
+            "stage": name,
+            "count": len(durs),
+            "total_ms": round(sum(durs) / 1000.0, 3),
+            "mean_ms": round(sum(durs) / len(durs) / 1000.0, 4)
+            if durs else 0.0,
+            "p50_ms": round(_pct(durs, 0.5) / 1000.0, 4),
+            "max_ms": round(max(durs) / 1000.0, 4) if durs else 0.0,
+        }
+
+    # plane stages first (pipeline order), then everything else by
+    # total time descending — the critical path reads top-down
+    ordered = [n for n in PLANE_STAGES if n in spans]
+    rest = sorted((n for n in spans if n not in PLANE_STAGES),
+                  key=lambda n: -sum(spans[n]))
+    stages = [row(n, spans[n]) for n in ordered + rest]
+
+    plane: Optional[dict] = None
+    if flights or pack_spans:
+        flight_total = sum(b - a for a, b in flights)
+        pack_total = sum(b - a for a, b in pack_spans)
+        overlapped = sum(_overlap_us(p, flights) for p in pack_spans)
+        plane = {
+            "flights": len(flights),
+            "flight_total_ms": round(flight_total / 1000.0, 3),
+            "pack_total_ms": round(pack_total / 1000.0, 3),
+            "pack_overlapped_ms": round(overlapped / 1000.0, 3),
+            "pack_overlap_frac": round(overlapped / pack_total, 3)
+            if pack_total else 0.0,
+        }
+    return {"stages": stages, "instants": instants, "plane": plane,
+            "events": len(events)}
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"{rep['events']} trace events",
+             "", f"{'stage':<26}{'count':>7}{'total ms':>11}"
+                 f"{'mean ms':>10}{'p50 ms':>10}{'max ms':>10}"]
+    for r in rep["stages"]:
+        lines.append(f"{r['stage']:<26}{r['count']:>7}"
+                     f"{r['total_ms']:>11.3f}{r['mean_ms']:>10.4f}"
+                     f"{r['p50_ms']:>10.4f}{r['max_ms']:>10.4f}")
+    if rep["plane"]:
+        p = rep["plane"]
+        lines += ["",
+                  f"verify-plane flights: {p['flights']} "
+                  f"({p['flight_total_ms']} ms airborne); "
+                  f"pack {p['pack_total_ms']} ms, "
+                  f"{p['pack_overlapped_ms']} ms "
+                  f"({p['pack_overlap_frac']:.0%}) hidden behind flights"]
+    if rep["instants"]:
+        lines += ["", "instants: " + ", ".join(
+            f"{k}×{v}" for k, v in sorted(rep["instants"].items()))]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage critical-path table from a Chrome trace")
+    ap.add_argument("trace", help="trace file (libs/tracing export)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rep = stage_report(load(args.trace))
+    print(json.dumps(rep) if args.json else format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
