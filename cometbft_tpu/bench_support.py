@@ -148,12 +148,19 @@ def mixed_commit_bench(chain_id: str, n_vals: int = 10_000,
     assert got_power == total_power
     assert bool(np.asarray(quorum)[0])
 
-    t = _now_ms()
-    outs = None
-    for _ in range(steady_k):
-        outs = one_pass(jax.device_put(rows_ed), jax.device_put(rows_sr))
-    assert bool(np.asarray(outs[3])[0])
-    steady = (_now_ms() - t) / steady_k
+    # best-of-3 steady loops (r05 post-mortem): a single K-pass wall on
+    # the shared tunnel carries multi-x run-to-run noise — cfg3 swung
+    # 110 -> 416 ms between rounds on an identical code path. The
+    # minimum is the reproducible device+transport cost.
+    steady = float("inf")
+    for _ in range(3):
+        t = _now_ms()
+        outs = None
+        for _ in range(steady_k):
+            outs = one_pass(jax.device_put(rows_ed),
+                            jax.device_put(rows_sr))
+        assert bool(np.asarray(outs[3])[0])
+        steady = min(steady, (_now_ms() - t) / steady_k)
 
     # CPU baseline: measured OpenSSL (C-speed) ed25519 verify per-sig,
     # applied to all 10k rows (conservative: CPU schnorrkel verification

@@ -268,6 +268,26 @@ class NodeMetrics:
             "Bytes of packed signature rows staged host-to-device by "
             "verify-plane flushes (valset tables are device-resident "
             "and excluded)")
+        # flush-ledger percentiles (PR 6): the always-on per-flush ring
+        # (verifyplane.plane.FlushLedger) sampled at scrape time —
+        # stage=queued|pack|flight|collect|settle, q=p50|p90|max, all
+        # over the ledger's bounded window (recent flushes, not
+        # lifetime)
+        self.plane_flush_stage_ms = r.gauge(
+            "verifyplane", "flush_stage_ms",
+            "Per-stage flush cost percentiles over the flush-ledger "
+            "window (labels: stage, q)")
+        self.plane_flush_overlap = r.gauge(
+            "verifyplane", "flush_overlap_frac",
+            "Fraction of pack time hidden behind an airborne flight "
+            "over the flush-ledger window")
+        self.plane_flush_ledger_size = r.gauge(
+            "verifyplane", "flush_ledger_records",
+            "Flush records currently held by the bounded ledger ring")
+        self.plane_flush_fallbacks = r.gauge(
+            "verifyplane", "flush_host_fallbacks_recent",
+            "Flushes in the ledger window that degraded to the host "
+            "path (dispatch failpoint or in-flight device fault)")
         # mempool
         self.mempool_size = r.gauge("mempool", "size",
                                     "Pending transactions")
@@ -350,6 +370,23 @@ class NodeMetrics:
                 float(sum(p["misses"] for p in pools)))
             self.staging_pool_bytes.set(
                 float(sum(p["resident_bytes"] for p in pools)))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            vp = sys.modules.get("cometbft_tpu.verifyplane.plane")
+            plane = vp and (vp._GLOBAL or vp._LAST)
+            if plane is not None:
+                s = plane.ledger.summary()
+                self.plane_flush_ledger_size.set(float(s["flushes"]))
+                if s["flushes"]:
+                    for stage, qs in s["stage_ms"].items():
+                        for q, v in qs.items():
+                            self.plane_flush_stage_ms.set(
+                                float(v), stage=stage, q=q)
+                    self.plane_flush_overlap.set(
+                        float(s["overlap_frac"]))
+                    self.plane_flush_fallbacks.set(
+                        float(s["host_fallback"]))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:

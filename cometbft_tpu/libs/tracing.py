@@ -185,20 +185,26 @@ _TRACER: Optional[Tracer] = None
 # module-default clock: installed by the simnet BEFORE/while a tracer
 # exists so deterministic runs never see a wall-clock timestamp
 _CLOCK: Optional[Callable[[], int]] = None
+# bumped whenever the clock monotonic_ns() resolves to can change
+# domain (set_clock / enable / disable): two monotonic_ns() readings
+# are only comparable when taken under the same generation
+_CLOCK_GEN: int = 0
 
 
 def enable(capacity: int = DEFAULT_CAPACITY,
            clock: Optional[Callable[[], int]] = None,
            deterministic: bool = False) -> Tracer:
     """Install (and return) a fresh global tracer."""
-    global _TRACER
+    global _TRACER, _CLOCK_GEN
     _TRACER = Tracer(capacity, clock, deterministic)
+    _CLOCK_GEN += 1
     return _TRACER
 
 
 def disable() -> None:
-    global _TRACER
+    global _TRACER, _CLOCK_GEN
     _TRACER = None
+    _CLOCK_GEN += 1
 
 
 def enabled() -> bool:
@@ -213,8 +219,9 @@ def set_clock(fn: Optional[Callable[[], int]]) -> None:
     """Install a ns clock for the current AND any future tracer. The
     simnet passes ``lambda: Timestamp.now().to_ns()`` so traces run on
     the virtual clock; None restores perf_counter_ns."""
-    global _CLOCK
+    global _CLOCK, _CLOCK_GEN
     _CLOCK = fn
+    _CLOCK_GEN += 1
     t = _TRACER
     if t is not None:
         t.set_clock(fn)
@@ -228,6 +235,31 @@ def clock_ns() -> Optional[int]:
     deterministic under the simnet's virtual clock."""
     t = _TRACER
     return None if t is None else t._clock()
+
+
+def monotonic_ns() -> int:
+    """Always-available ns clock for ALWAYS-ON accounting (the verify
+    plane's flush ledger): the tracer's clock when one is enabled (so
+    ledger stamps share the trace timeline), else the module clock when
+    installed (virtual under simnet — ledgers of the same (seed,
+    schedule) replay identically), else time.perf_counter_ns. Unlike
+    :func:`clock_ns` this never returns None: the ledger records every
+    flush whether or not tracing is on."""
+    t = _TRACER
+    if t is not None:
+        return t._clock()
+    c = _CLOCK
+    return c() if c is not None else time.perf_counter_ns()
+
+
+def clock_gen() -> int:
+    """Generation counter for :func:`monotonic_ns`'s clock domain.
+    Holders of a stored stamp (the verify plane's submit-time
+    queued_ms anchor) compare generations before differencing two
+    readings: a simnet clock install/restore between stamp and use
+    would otherwise difference a virtual-epoch ns against a
+    perf_counter ns and produce a garbage duration."""
+    return _CLOCK_GEN
 
 
 def span(name: str, cat: str = "", **args):

@@ -550,6 +550,18 @@ class Routes:
 
         return tracing.export_chrome()
 
+    def dump_flushes(self):
+        """The verify plane's always-on flush ledger: per-flush stage
+        costs + percentile summary (also served as GET /dump_flushes).
+        Unlike /dump_traces this needs no knob — the ledger records
+        every flush, and survives the plane being stopped."""
+        from cometbft_tpu import verifyplane
+
+        plane = getattr(self.node, "verify_plane", None)
+        if plane is not None:
+            return plane.dump_flushes()
+        return verifyplane.dump_flushes()
+
 
 _ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
@@ -559,7 +571,7 @@ _ROUTES = [
     "abci_info", "abci_query", "check_tx", "broadcast_evidence",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
-    "block_search", "dump_traces",
+    "block_search", "dump_traces", "dump_flushes",
 ]
 
 # only served when the server runs with unsafe=True
@@ -670,6 +682,16 @@ class _Handler(BaseHTTPRequestHandler):
             from cometbft_tpu.libs import tracing
 
             body = json.dumps(tracing.export_chrome()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/dump_flushes":
+            # the verify plane's always-on flush ledger (PR 6): what
+            # the last few hundred flushes cost, no tracing knob needed
+            body = json.dumps(self.routes.dump_flushes()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -28,16 +28,32 @@ class SimnetFailure(AssertionError):
     its phase boundaries — rerun the originating test, whose code IS
     that phase structure."""
 
-    def __init__(self, msg: str, seed: int, schedule: List[Dict]):
+    def __init__(self, msg: str, seed: int, schedule: List[Dict],
+                 include_ledger: bool = True):
         self.seed = seed
         self.schedule = schedule
-        text = f"{msg}\nreplay: {schedule_to_json(seed, schedule)}"
+        text = msg
         # when tracing is on, the tail of the span/event ring rides the
         # failure: the last thing the simulation did before wedging,
         # in order, on the virtual clock
         trace_tail = tracing.tail(40)
         if trace_tail:
             text += "\ntrace tail: " + " ".join(trace_tail)
+        # the verify plane's always-on flush ledger needs no knob: if a
+        # plane ran (or stopped) during this simulation, its last few
+        # flushes ride the blob too — stage costs on the virtual clock.
+        # The harness passes include_ledger=False when the ledger never
+        # moved during ITS run (the module-global ledger survives
+        # unrelated earlier planes in the same process — that history
+        # would misdirect whoever debugs this blob).
+        from cometbft_tpu import verifyplane
+
+        led_tail = verifyplane.ledger_tail(8) if include_ledger else []
+        if led_tail:
+            text += "\nflush ledger tail: " + " | ".join(led_tail)
+        # the replay blob stays LAST: consumers (and the fuzzer) parse
+        # everything after "replay:" as one JSON document
+        text += f"\nreplay: {schedule_to_json(seed, schedule)}"
         super().__init__(text)
 
 
@@ -48,6 +64,11 @@ class Simnet:
         self.net = SimNetwork(n_nodes, seed, basedir, **kw)
         self.schedule: List[Dict] = []
         self._started = False
+        # flush-ledger position at sim start: failure blobs attach the
+        # ledger tail only if it advanced during THIS simulation
+        from cometbft_tpu import verifyplane
+
+        self._ledger_mark = verifyplane.ledger_mark()
 
     # -- running -----------------------------------------------------------
 
@@ -164,7 +185,12 @@ class Simnet:
     # -- assertions --------------------------------------------------------
 
     def _fail(self, msg: str) -> "SimnetFailure":
-        return SimnetFailure(msg, self.net.seed, self.schedule)
+        from cometbft_tpu import verifyplane
+
+        return SimnetFailure(
+            msg, self.net.seed, self.schedule,
+            include_ledger=verifyplane.ledger_advanced(self._ledger_mark),
+        )
 
     def commit_hashes(self) -> List[Dict[int, bytes]]:
         """Per-node height -> committed block hash (incl. killed nodes'

@@ -7,6 +7,7 @@ bucket batches, flushes on a micro-batch deadline or a full bucket, and
 fuses per-group voting-power tallies into the same pass.
 """
 from cometbft_tpu.verifyplane.plane import (
+    FlushLedger,
     PlaneError,
     PlaneQueueFull,
     PlaneStopped,
@@ -14,12 +15,17 @@ from cometbft_tpu.verifyplane.plane import (
     VerifyFuture,
     VerifyPlane,
     clear_global_plane,
+    dump_flushes,
     global_plane,
+    ledger_advanced,
+    ledger_mark,
+    ledger_tail,
     plane_batch_fn,
     set_global_plane,
 )
 
 __all__ = [
+    "FlushLedger",
     "PlaneError",
     "PlaneQueueFull",
     "PlaneStopped",
@@ -27,7 +33,11 @@ __all__ = [
     "VerifyFuture",
     "VerifyPlane",
     "clear_global_plane",
+    "dump_flushes",
     "global_plane",
+    "ledger_advanced",
+    "ledger_mark",
+    "ledger_tail",
     "plane_batch_fn",
     "set_global_plane",
 ]

@@ -63,6 +63,125 @@ DISPATCH_LOG_MAX = 64       # flush-composition ring kept for tests/ops
 # must never reuse an id — perfetto and trace_report would pair plane
 # A's begin with plane B's end. next() on itertools.count is atomic.
 _FLUSH_IDS = itertools.count()
+
+# -- flush ledger ----------------------------------------------------------
+# The trace plane (PR 5) can reconstruct one run in full detail, but it
+# is OFF by default — so the r05-style question "what did the last few
+# hundred flushes actually cost" had no answer on a production node.
+# The ledger is the always-on counterpart: one compact tuple per flush
+# in a bounded ring, cheap enough to never turn off. The ring slot is
+# the only per-flush allocation; every stamp rides
+# tracing.monotonic_ns(), which the simnet swaps for its virtual clock
+# — same (seed, schedule) => identical ledger.
+
+LEDGER_CAPACITY = 256
+
+# flush dispatch paths (interned module constants — the ledger must not
+# build strings per flush)
+PATH_FUSED = "fused"                # cached-table device pass, airborne
+PATH_GROUPED = "grouped"            # generic device pass (sync)
+PATH_HOST = "host"                  # no accelerator: inline host verify
+PATH_FAILPOINT = "failpoint_host"   # dispatch failpoint degraded flush
+PATH_FUSED_FALLBACK = "fused_host_fallback"  # in-flight device fault
+PATH_STOP_DRAIN = "stop_drain"      # settled by stop()'s drain budget
+
+# Record-field indices. A flush's record is ONE list allocated at stage
+# time in FIELDS order (plus two trailing internal ns stamps the readers
+# never see); the dispatcher mutates it in place as stages land and the
+# very same list becomes the ring slot — "no allocation per flush beyond
+# the ring slot" is literal, not approximate.
+(_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
+ _L_COLLECT, _L_SETTLE, _L_OVER, _L_PATH, _L_BRK, _L_SMISS,
+ _L_DEPTH) = range(14)
+# internal slots past the FIELDS window: two ns stamps + the clock
+# generation they were taken under (readers never see these)
+_L_T0NS, _L_TPACKED, _L_GEN = 14, 15, 16
+
+
+class FlushLedger:
+    """Bounded ring of per-flush records.
+
+    Record fields (see ``FIELDS``): per-plane sequence number, flush
+    timestamp (ms on the ledger clock), row/submission counts, the
+    per-stage costs (queued/pack/flight/collect/settle ms), whether the
+    pack overlapped an airborne flight, the dispatch path taken, the
+    breaker state observed at stage time, staging-pool misses charged
+    to this flush, and the queue depth left behind. Written by the
+    dispatcher even when tracing is off; read by /dump_flushes, the
+    scrape-time /metrics percentiles, and simnet replay blobs."""
+
+    FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
+              "flight_ms", "collect_ms", "settle_ms", "overlapped",
+              "path", "breaker", "staging_miss", "depth")
+
+    __slots__ = ("_ring",)
+
+    def __init__(self, capacity: int = LEDGER_CAPACITY):
+        self._ring = deque(maxlen=max(16, int(capacity)))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, rec: list) -> None:
+        self._ring.append(rec)
+
+    def records(self) -> List[dict]:
+        """The ring as dicts, oldest first (dict construction happens
+        at READ time — dump/scrape — never on the flush path)."""
+        # list(deque) snapshots atomically under the GIL (one C call);
+        # zip(FIELDS, r) stops at the FIELDS window, so the two internal
+        # ns stamps trailing each record never leak into a dump
+        return [dict(zip(self.FIELDS, r)) for r in list(self._ring)]
+
+    def tail(self, n: int = 8) -> List[str]:
+        """The last n flushes as compact strings — small enough to ride
+        a simnet replay blob."""
+        out = []
+        for r in list(self._ring)[-n:]:
+            out.append(
+                f"#{r[_L_SEQ]} rows={r[_L_ROWS]} {r[_L_PATH]} "
+                f"queued={r[_L_QUEUED]}ms pack={r[_L_PACK]}ms "
+                f"flight={r[_L_FLIGHT]}ms collect={r[_L_COLLECT]}ms "
+                f"settle={r[_L_SETTLE]}ms"
+                + (" overlapped" if r[_L_OVER] else "")
+            )
+        return out
+
+    def summary(self) -> dict:
+        """Percentile summary over the ring (computed at read time)."""
+        recs = list(self._ring)
+        if not recs:
+            return {"flushes": 0}
+        cols = {name: [r[i] for r in recs]
+                for i, name in enumerate(self.FIELDS)}
+
+        def pcts(xs):
+            s = sorted(xs)
+            pick = lambda q: s[min(len(s) - 1,
+                                   int(round(q * (len(s) - 1))))]
+            return {"p50": pick(0.5), "p90": pick(0.9), "max": s[-1]}
+
+        pack_total = sum(cols["pack_ms"])
+        pack_over = sum(p for p, o in zip(cols["pack_ms"],
+                                          cols["overlapped"]) if o)
+        paths: dict = {}
+        for p in cols["path"]:
+            paths[p] = paths.get(p, 0) + 1
+        return {
+            "flushes": len(recs),
+            "rows": int(sum(cols["rows"])),
+            "stage_ms": {k: pcts(cols[f"{k}_ms"])
+                         for k in ("queued", "pack", "flight", "collect",
+                                   "settle")},
+            "rows_per_flush": pcts(cols["rows"]),
+            "overlap_frac": round(pack_over / pack_total, 3)
+            if pack_total else 0.0,
+            "paths": paths,
+            "staging_miss": int(sum(cols["staging_miss"])),
+            "host_fallback": sum(
+                paths.get(p, 0) for p in (PATH_FAILPOINT,
+                                          PATH_FUSED_FALLBACK)),
+        }
 DEFAULT_RESULT_TIMEOUT = 30.0
 # stop()-time leftover drain budget: rows host-verified synchronously
 # before remaining futures fail fast (a few seconds worst-case on the
@@ -175,7 +294,7 @@ class QuorumGroup:
 
 class _Submission:
     __slots__ = ("rows", "future", "group", "power", "counted",
-                 "vidx", "t_submit", "t_submit_trace", "tid")
+                 "vidx", "t_submit", "t_submit_led", "clock_gen", "tid")
 
     def __init__(self, rows, group, power, counted, vidx=None):
         self.rows = rows                      # [(PubKey, msg, sig), ...]
@@ -185,10 +304,16 @@ class _Submission:
         self.counted = bool(counted)
         self.vidx = tuple(vidx) if vidx is not None else None
         self.t_submit = time.perf_counter()
-        # trace-clock stamp for the pack span's queued_ms: rides the
-        # TRACE clock (virtual under simnet) so traces of the same
-        # (seed, schedule) stay byte-identical; None when tracing off
-        self.t_submit_trace = tracing.clock_ns()
+        # ledger/trace-clock stamp for queued_ms: rides the ledger
+        # clock (== the trace clock when tracing is on; virtual under
+        # simnet) so ledgers AND traces of the same (seed, schedule)
+        # stay byte-identical. Always stamped — the flush ledger needs
+        # it with tracing off too.
+        self.t_submit_led = tracing.monotonic_ns()
+        # the stamp is only comparable to a flush-time reading taken
+        # under the same clock generation (simnet clock install/restore
+        # between submit and flush would difference two domains)
+        self.clock_gen = tracing.clock_gen()
         self.tid = threading.get_ident()
 
 
@@ -242,6 +367,10 @@ class VerifyPlane:
         self.pack_seconds = 0.0   # host staging time (template pack etc.)
         self.h2d_bytes = 0        # bytes staged to the device
         self.overlapped = 0       # flushes packed while another flew
+        # always-on flush ledger (bounded ring; survives stop() — it is
+        # read-only history, never cleared by the lifecycle)
+        self.ledger = FlushLedger()
+        self._flush_seq = itertools.count()  # per-plane, deterministic
         # PRIVATE staging pool: the rotation contract (one writer per
         # key) only holds per dispatcher thread — two planes in one
         # process (multi-node tests, simnet) must never share slots
@@ -290,7 +419,19 @@ class VerifyPlane:
                 fail.append(sub)
         if settle:
             rows = [r for sub in settle for r in sub.rows]
-            self._settle(settle, _host_verdicts(rows))
+            t0 = tracing.monotonic_ns()
+            verdicts = _host_verdicts(rows)
+            t1 = tracing.monotonic_ns()
+            self._settle(settle, verdicts)
+            # the drain is a flush too: the ledger must explain where
+            # shutdown time went (and survive into post-stop dumps)
+            self.ledger.record([
+                next(self._flush_seq), round(t0 / 1e6, 3), len(rows),
+                len(settle), 0.0, 0.0, 0.0,
+                round((t1 - t0) / 1e6, 3),
+                round((tracing.monotonic_ns() - t1) / 1e6, 3),
+                False, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
+            ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
                 "verify plane stopped with queue over the drain budget"
@@ -385,9 +526,10 @@ class VerifyPlane:
         flush already in flight the window wait is skipped: the
         in-flight pass IS the coalescing amortization the window
         exists to provide."""
-        inflight = None  # airborne (batch, finish, True, flush_id)
+        inflight = None  # airborne (batch, finish, True, flush_id, led)
         while True:
             batch: List[_Submission] = []
+            depth = 0
             with self._cv:
                 while self._running:
                     if self._pending:
@@ -415,15 +557,17 @@ class VerifyPlane:
                     rows += nxt
                     batch.append(sub)
                 self._pending_rows -= rows
+                depth = self._pending_rows
                 if self.metrics is not None:
                     self.metrics.plane_queue_depth.set(self._pending_rows)
                 self._cv.notify_all()  # wake backpressured submitters
-            flight = self._stage(batch) if batch else None
+            flight = self._stage(batch, depth) if batch else None
             if inflight is not None:
                 # real overlap only: the previous flight was airborne on
                 # the device while this flush packed on the host
                 if flight is not None:
                     self.overlapped += 1
+                    flight[4][_L_OVER] = True
                 self._finish_flight(inflight)
                 inflight = None
             if flight is not None:
@@ -438,20 +582,55 @@ class VerifyPlane:
             self._finish_flight(inflight)
 
     def _finish_flight(self, flight) -> None:
-        batch, finish, airborne, fid = flight
+        # hook audit (r05 post-mortem suspect #1): every tracing span
+        # here sits behind an `enabled()` check so the DISABLED path
+        # constructs no span object and no kwargs dict — the only
+        # per-flush bookkeeping is the ledger stamps (plain int clock
+        # reads) and the ring tuple.
+        batch, finish, airborne, fid, led = flight
+        traced = tracing.enabled()
+        t_exec = tracing.monotonic_ns()
         if airborne:
-            with tracing.span("plane.collect", cat="verifyplane",
-                              flush=fid):
+            if traced:
+                with tracing.span("plane.collect", cat="verifyplane",
+                                  flush=fid):
+                    verdicts, fused_tallies = finish()
+                tracing.flight_end("plane.flight", fid, cat="verifyplane")
+            else:
                 verdicts, fused_tallies = finish()
-            tracing.flight_end("plane.flight", fid, cat="verifyplane")
         else:
             # synchronous flush: the deferred host/grouped verification
             # happens here, attributed to its own stage
-            with tracing.span("plane.verify", cat="verifyplane",
-                              flush=fid):
+            if traced:
+                with tracing.span("plane.verify", cat="verifyplane",
+                                  flush=fid):
+                    verdicts, fused_tallies = finish()
+            else:
                 verdicts, fused_tallies = finish()
-        with tracing.span("plane.settle", cat="verifyplane", flush=fid):
+        t_settle = tracing.monotonic_ns()
+        if traced:
+            with tracing.span("plane.settle", cat="verifyplane",
+                              flush=fid):
+                self._settle(batch, verdicts, fused_tallies=fused_tallies)
+        else:
             self._settle(batch, verdicts, fused_tallies=fused_tallies)
+        t_done = tracing.monotonic_ns()
+        # flight_ms: time the pass was airborne before the dispatcher
+        # came back for it (the overlap window the double buffer wins);
+        # collect_ms: the blocking fetch (or the sync verify itself).
+        # The scratch list mutates in place and becomes the ring slot.
+        # Differencing needs every stamp from one clock domain: a
+        # tracing enable/disable or simnet clock install/restore while
+        # the flush was airborne (test/bench teardown) would difference
+        # a virtual-epoch ns against a perf_counter ns — same hazard
+        # queued_ms guards with clock_gen at pack time. The stage
+        # timings are recorded as 0.0 then; the record itself stays.
+        if tracing.clock_gen() == led[_L_GEN]:
+            if airborne:
+                led[_L_FLIGHT] = round((t_exec - led[_L_TPACKED]) / 1e6, 3)
+            led[_L_COLLECT] = round((t_settle - t_exec) / 1e6, 3)
+            led[_L_SETTLE] = round((t_done - t_settle) / 1e6, 3)
+        self.ledger.record(led)
 
     def _observe_pack(self, seconds: float, h2d_bytes: int = 0) -> None:
         self.pack_seconds += seconds
@@ -461,32 +640,56 @@ class VerifyPlane:
             if h2d_bytes:
                 self.metrics.plane_h2d_bytes.inc(h2d_bytes)
 
-    def _stage(self, batch: List[_Submission]):
+    def _stage(self, batch: List[_Submission], depth: int = 0):
         """Pack one flush and (when eligible) launch it on the device
         WITHOUT waiting for results. Returns (batch, finish, airborne,
-        flush_id) where finish() blocks for the verdicts — the seam
-        that lets the dispatcher pack the next flush while this one
-        flies. The whole host-side staging is one "plane.pack" trace
-        span keyed by flush id, so pack(k+1) visibly overlaps
-        device-flight(k) in the exported timeline."""
+        flush_id, ledger_scratch) where finish() blocks for the
+        verdicts — the seam that lets the dispatcher pack the next
+        flush while this one flies. The whole host-side staging is one
+        "plane.pack" trace span keyed by flush id, so pack(k+1) visibly
+        overlaps device-flight(k) in the exported timeline.
+
+        Ledger accounting happens on BOTH paths: the disabled-tracing
+        fast path still stamps the clock and fills the scratch list
+        (ints and interned strings only — no dict/span construction,
+        the r05 post-mortem's suspect #1)."""
         fid = next(_FLUSH_IDS)
+        t0 = tracing.monotonic_ns()
+        gen = tracing.clock_gen()
+        t_min = None
+        rows = 0
+        for s in batch:
+            rows += len(s.rows)
+            if s.clock_gen != gen:
+                # stamped under a different clock domain (simnet clock
+                # swapped between submit and flush): unusable for a wait
+                continue
+            ts = s.t_submit_led
+            if t_min is None or ts < t_min:
+                t_min = ts
+        queued_ms = round((t0 - t_min) / 1e6, 3) if t_min is not None \
+            else 0.0
+        # FIELDS-ordered record + internal slots (t0, t_packed, clock
+        # gen); this list IS the eventual ring slot
+        led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
+               len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, False,
+               PATH_HOST, self._breaker.state, 0, depth, t0, t0, gen]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path
-            batch, finish, airborne = self._stage_inner(batch, fid)
-            return batch, finish, airborne, fid
-        now_ns = tracing.clock_ns()
-        stamps = [s.t_submit_trace for s in batch
-                  if s.t_submit_trace is not None]
-        args = {"flush": fid, "rows": sum(len(s.rows) for s in batch),
-                "subs": len(batch)}
-        if stamps and now_ns is not None:
-            args["queued_ms"] = round((now_ns - min(stamps)) / 1e6, 3)
-        with tracing.span("plane.pack", cat="verifyplane", **args):
-            batch, finish, airborne = self._stage_inner(batch, fid)
-        return batch, finish, airborne, fid
+            batch, finish, airborne = self._stage_inner(batch, fid, led)
+        else:
+            with tracing.span("plane.pack", cat="verifyplane", flush=fid,
+                              rows=rows, subs=len(batch),
+                              queued_ms=queued_ms):
+                batch, finish, airborne = self._stage_inner(batch, fid,
+                                                            led)
+        t1 = tracing.monotonic_ns()
+        led[_L_PACK] = round((t1 - t0) / 1e6, 3)
+        led[_L_TPACKED] = t1
+        return batch, finish, airborne, fid, led
 
-    def _stage_inner(self, batch: List[_Submission], fid: int):
+    def _stage_inner(self, batch: List[_Submission], fid: int, led):
         """The breaker's allow() — which consumes the single half-open
         probe slot when the breaker is open — is only asked once a
         fused plan exists, i.e. when a device attempt will actually
@@ -494,6 +697,7 @@ class VerifyPlane:
         generic path needs to recover."""
         rows = [r for sub in batch for r in sub.rows]
         t0 = time.perf_counter()
+        miss0 = self._staging.misses
         try:
             fp.fail_point("verifyplane.dispatch")
         except Exception:  # noqa: BLE001 - dispatch fault, not verdicts
@@ -504,6 +708,7 @@ class VerifyPlane:
             # verdict work is deferred into finish() so the pack span
             # measures staging only (the finish runs immediately for
             # synchronous flushes — same thread, same ordering)
+            led[_L_PATH] = PATH_FAILPOINT
             return batch, (lambda: (_host_verdicts(rows), None)), False
         plan = None
         if self._use_device and self._kernels is None:
@@ -528,6 +733,8 @@ class VerifyPlane:
                                      cat="verifyplane", rows=len(rows))
                 self._observe_pack(time.perf_counter() - t0,
                                    fz.plan_h2d_bytes(plan))
+                led[_L_PATH] = PATH_FUSED
+                led[_L_SMISS] = self._staging.misses - miss0
 
                 def finish():
                     try:
@@ -538,6 +745,7 @@ class VerifyPlane:
                             "fused verify-plane flush failed in flight; "
                             "host fallback for this flush"
                         )
+                        led[_L_PATH] = PATH_FUSED_FALLBACK
                         return _host_verdicts(rows), None
                     finally:
                         if prof is not None:
@@ -555,6 +763,8 @@ class VerifyPlane:
                     "to the grouped path"
                 )
         self._observe_pack(time.perf_counter() - t0)
+        led[_L_PATH] = PATH_GROUPED if self._use_device else PATH_HOST
+        led[_L_SMISS] = self._staging.misses - miss0
         # deferred like the failpoint arm: pack_seconds (and the
         # plane.pack span) cover staging; the host/grouped verify runs
         # inside finish() under its own plane.verify span
@@ -639,6 +849,16 @@ class VerifyPlane:
             "pack_seconds": self.pack_seconds,
             "h2d_bytes": self.h2d_bytes,
             "overlapped": self.overlapped,
+            "flushes_logged": len(self.ledger),
+        }
+
+    def dump_flushes(self) -> dict:
+        """The always-on flush ledger: per-flush records + percentile
+        summary (served by /dump_flushes; works after stop() too)."""
+        return {
+            "running": self._running,
+            "summary": self.ledger.summary(),
+            "flushes": self.ledger.records(),
         }
 
 
@@ -647,13 +867,19 @@ class VerifyPlane:
 # --------------------------------------------------------------------------
 
 _GLOBAL: Optional[VerifyPlane] = None
+# the last plane that was ever global: /dump_flushes and simnet replay
+# blobs read its ledger even after the node stopped the plane (the
+# ledger is history, and post-mortems happen after shutdown)
+_LAST: Optional[VerifyPlane] = None
 _GLOBAL_LOCK = threading.Lock()
 
 
 def set_global_plane(plane: Optional[VerifyPlane]) -> None:
-    global _GLOBAL
+    global _GLOBAL, _LAST
     with _GLOBAL_LOCK:
         _GLOBAL = plane
+        if plane is not None:
+            _LAST = plane
 
 
 def clear_global_plane(plane: VerifyPlane) -> None:
@@ -672,6 +898,44 @@ def global_plane() -> Optional[VerifyPlane]:
     if p is None or not p.is_running() or p.in_dispatcher():
         return None
     return p
+
+
+def dump_flushes() -> dict:
+    """The flush ledger of the current global plane — or, after a
+    stop, of the LAST plane that was global (the ledger survives
+    stop(): a post-mortem reads history, not liveness)."""
+    p = _GLOBAL or _LAST
+    if p is None:
+        return {"running": False, "summary": {"flushes": 0},
+                "flushes": []}
+    return p.dump_flushes()
+
+
+def ledger_tail(n: int = 8) -> List[str]:
+    """Compact tail of the most recent flushes (rides simnet replay
+    blobs next to the trace tail)."""
+    p = _GLOBAL or _LAST
+    return [] if p is None else p.ledger.tail(n)
+
+
+def ledger_mark() -> tuple:
+    """Opaque position marker for :func:`ledger_advanced`: which plane
+    the module-level ledger readers currently resolve to, and how far
+    its ring has been written. ``_LAST`` is process-global and never
+    cleared, so a consumer that only wants flushes from ITS OWN window
+    of activity (the simnet replay blob) marks at start and attaches
+    the tail only when the ledger moved past the mark."""
+    p = _GLOBAL or _LAST
+    if p is None:
+        return (None, -1)
+    ring = p.ledger._ring
+    return (id(p), ring[-1][_L_SEQ] if ring else -1)
+
+
+def ledger_advanced(mark: tuple) -> bool:
+    """True when any flush was recorded after ``mark`` (a new plane
+    became global, or the marked plane's ring grew)."""
+    return ledger_mark() != mark
 
 
 def plane_batch_fn() -> Optional[Callable]:

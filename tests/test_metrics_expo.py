@@ -254,6 +254,52 @@ def test_scrape_samples_breaker_and_staging():
     assert res[0][2] >= 16  # the 4x int32 test buffers are resident
 
 
+def test_scrape_staging_stats_move_under_flush_traffic():
+    """ISSUE 6 satellite: the scrape-time pool stats (hits/misses/
+    resident bytes) MOVE correctly as flush traffic rotates buffers —
+    including the verify plane's PRIVATE pool, which only the scrape
+    aggregation can see."""
+    from cometbft_tpu.crypto import batch as cbatch
+    from cometbft_tpu.verifyplane import (
+        VerifyPlane,
+        clear_global_plane,
+        set_global_plane,
+    )
+
+    def pool_kinds(text):
+        fams = parse_promtext(text)
+        kinds = {s[1].get("kind"): s[2] for s in
+                 fams["cometbft_crypto_staging_pool_total"]["samples"]
+                 if s[1]}
+        res = fams["cometbft_crypto_staging_pool_resident_bytes"]
+        return kinds, res["samples"][0][2]
+
+    m = NodeMetrics()
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    try:
+        before, res_before = pool_kinds(m.expose_text())
+        # rotate the plane's PRIVATE pool like concurrent device
+        # flushes would: slots misses to warm a fresh shape, then hits
+        for _ in range(5):
+            plane._staging.get("expo.flush", (8, 4), "int32")
+        # and the process-global pool (blocksync/bench path)
+        cbatch.staging_pool().get("expo.flush2", (2, 2), "int32")
+        after, res_after = pool_kinds(m.expose_text())
+        # the private pool's 2 slots were allocation misses, the other
+        # 3 gets were rotation hits; the global pool added 1 miss
+        assert after.get("misses", 0) >= before.get("misses", 0) + 3
+        assert after.get("hits", 0) >= before.get("hits", 0) + 3
+        # resident bytes grew by exactly the new buffers: 2 slots of
+        # 8x4 int32 (private pool) + the single allocated 2x2 int32
+        # slot (global pool lazily allocates per get)
+        assert res_after - res_before == 2 * 8 * 4 * 4 + 1 * 2 * 2 * 4
+    finally:
+        clear_global_plane(plane)
+        plane.stop()
+
+
 def test_metrics_lint_nodemetrics_clean():
     """CI gate: the full node metric set obeys the naming conventions
     (counters _total, histograms seconds/bytes/rows, no dupes)."""

@@ -266,3 +266,73 @@ def test_staging_pool_rotation_and_reuse():
     assert d is not a and e is not a
     st = p.stats()
     assert st["hits"] == 1 and st["misses"] == 4
+
+
+def test_staging_pool_concurrent_flushes():
+    """ISSUE 6 satellite: the pool under concurrent flush traffic.
+
+    The rotation contract is one writer per KEY (each dispatcher/
+    pipeline owns its buffer names), but nothing serializes DIFFERENT
+    keys — the verify-plane dispatcher, blocksync's private pool
+    pattern, and bench all hammer one process-global pool from their
+    own threads. Each thread here rotates its own key under load and
+    checks its buffer still holds its own pattern after every get
+    (cross-key aliasing would corrupt it); the lock-protected counters
+    must come out EXACT, not approximately."""
+    import threading
+
+    from cometbft_tpu.libs.staging import StagingPool
+
+    slots, iters, n_threads = 2, 200, 6
+    p = StagingPool(slots=slots)
+    errs = []
+    start = threading.Barrier(n_threads)
+
+    def flusher(tid):
+        try:
+            start.wait(5)
+            for i in range(iters):
+                buf = p.get(f"flush.t{tid}", (16, 8), np.int32)
+                if buf.any():  # zeroed on every handout
+                    raise AssertionError(f"t{tid} got a dirty buffer")
+                buf[:] = tid * 1000 + i
+                # the buffer must still be OURS after other threads run
+                # their own gets (no cross-key slot sharing)
+                if not (buf == tid * 1000 + i).all():
+                    raise AssertionError(f"t{tid} buffer overwritten")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=flusher, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    st = p.stats()
+    # exhaustion accounting: per key exactly `slots` allocation misses,
+    # every other get recycled a slot (a rotation hit)
+    assert st["misses"] == n_threads * slots
+    assert st["hits"] == n_threads * (iters - slots)
+    assert st["shapes"] == n_threads
+    assert st["resident_bytes"] == n_threads * slots * 16 * 8 * 4
+
+
+def test_staging_pool_exhaustion_aliases_oldest():
+    """More outstanding buffers than slots is the documented hazard:
+    request slots+1 of one key while all are 'in flight' and the pool
+    recycles the OLDEST — callers must be done writing before asking
+    for `slots` more. The stats make the exhaustion visible (hits move
+    while misses stay at the slot count)."""
+    from cometbft_tpu.libs.staging import StagingPool
+
+    p = StagingPool(slots=3)
+    outstanding = [p.get("x", (4,), np.int64) for _ in range(3)]
+    assert p.stats()["misses"] == 3 and p.stats()["hits"] == 0
+    again = p.get("x", (4,), np.int64)  # exhausted: recycles slot 0
+    assert again is outstanding[0]
+    assert p.stats()["hits"] == 1 and p.stats()["misses"] == 3
+    # resident footprint never grows past slots x shape
+    assert p.stats()["resident_bytes"] == 3 * 4 * 8
+    assert p.nbytes() == 3 * 4 * 8

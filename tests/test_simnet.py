@@ -179,6 +179,45 @@ def test_garbage_signer_does_not_poison_verify_plane(tmp_path):
         plane.stop()
 
 
+def test_flush_ledger_deterministic_under_simnet(tmp_path):
+    """ISSUE 6 acceptance: the always-on flush ledger rides the virtual
+    clock — the same (seed, schedule) with a verify plane running
+    produces IDENTICAL ledger records (sequence, composition, paths,
+    and every stage timing), because submissions are serialized by the
+    single-threaded event loop and every stamp comes from
+    tracing.monotonic_ns() (= Timestamp.now() under simnet). Also
+    proves the ledger is on by default (no knob was touched) and
+    survives plane.stop()."""
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    def run_once(tag):
+        plane = VerifyPlane(window_ms=0.5, use_device=False)
+        plane.start()
+        set_global_plane(plane)
+        try:
+            with Simnet(3, seed=33, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(
+                    [{"at": 0.1, "op": "link", "drop": 0.03,
+                      "delay": 0.01}],
+                    until_height=2, max_time=60.0,
+                )
+                sim.assert_safety()
+        finally:
+            set_global_plane(None)
+            plane.stop()
+        recs = plane.dump_flushes()["flushes"]
+        assert recs, "plane saw no flushes — ledger not always-on?"
+        return recs
+
+    a = run_once("a")
+    b = run_once("b")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # and the stamps really rode the virtual clock: inside the sim epoch
+    from cometbft_tpu.simnet.core import SIM_EPOCH_SECONDS
+
+    assert all(r["ts_ms"] >= SIM_EPOCH_SECONDS * 1e3 for r in a)
+
+
 def test_light_client_attack_evidence_committed(tmp_path):
     """A >=1/3 coalition's forged header reaches one honest node as
     LightClientAttackEvidence (with its conflicting-commit proof),
@@ -241,3 +280,51 @@ def test_failure_carries_replay_blob(tmp_path):
         validate_schedule(blob["schedule"], 4)
         assert schedule_to_json(9, sched) == json.dumps(
             blob, sort_keys=True)
+
+
+def test_failure_carries_flush_ledger_tail():
+    """ISSUE 6: when a verify plane ran, a SimnetFailure carries the
+    ledger tail (the last flushes' stage costs) — and the replay blob
+    stays the LAST line, still one parseable JSON document."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    plane = VerifyPlane(window_ms=0.2, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    try:
+        k = PrivKey.generate(b"\x09" * 32)
+        plane.submit(k.pub_key(), b"m", k.sign(b"m")).result(5)
+    finally:
+        set_global_plane(None)
+        plane.stop()
+    sched = [{"at": 0.1, "op": "heal"}]
+    msg = str(SimnetFailure("boom", 7, sched))
+    assert "flush ledger tail:" in msg
+    blob = json.loads(msg.split("replay:", 1)[1])
+    assert blob["seed"] == 7 and blob["schedule"] == sched
+
+
+def test_stale_ledger_tail_skipped(tmp_path):
+    """The module-global ledger survives unrelated earlier planes in
+    the same process; a simulation during which the ledger never moved
+    must not attach that stale history to its failure blob."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    plane = VerifyPlane(window_ms=0.2, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    try:
+        k = PrivKey.generate(b"\x0c" * 32)
+        plane.submit(k.pub_key(), b"m", k.sign(b"m")).result(5)
+    finally:
+        set_global_plane(None)
+        plane.stop()
+    # the stopped plane is still readable history (/dump_flushes), but
+    # this sim never runs one — its blob must skip the foreign tail
+    with Simnet(2, seed=13, basedir=str(tmp_path)) as sim:
+        msg = str(sim._fail("boom"))
+    assert "flush ledger tail:" not in msg
+    blob = json.loads(msg.split("replay:", 1)[1])
+    assert blob["seed"] == 13

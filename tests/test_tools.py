@@ -97,3 +97,42 @@ def test_wal_rotation_and_group_replay(tmp_path):
     tail = list(walmod.WAL.iter_records(path))[idx:]
     assert any(r.kind == walmod.END_HEIGHT
                and struct.unpack(">q", r.data)[0] == 29 for r in tail)
+
+
+def test_bench_history_renders_trajectory(tmp_path, capsys):
+    """tools/bench_history: driver-shaped BENCH files (head-truncated
+    tails included) line up per config across rounds; missing configs
+    render as '—', never as a guessed value."""
+    import json
+
+    from tools import bench_history
+
+    r1 = {"n": 1, "rc": 0, "tail": "\n".join([
+        '{"metric": "cfg2 1000-validator commit batch verify", '
+        '"value": 8.6, "unit": "ms", "vs_baseline": 10.0}',
+        '{"metric": "10k-validator VerifyCommitLight fused p50", '
+        '"value": 38.5, "unit": "ms", "vs_baseline": 33.0}',
+    ])}
+    # round 2's tail lost cfg2 to head truncation (first line cut mid-
+    # object, exactly how the driver stores long stdouts)
+    r2 = {"n": 2, "rc": 0, "tail": "\n".join([
+        'alue": 15.2, "unit": "ms"}',
+        '{"metric": "10k-validator VerifyCommitLight fused p50", '
+        '"value": 29.0, "unit": "ms", "vs_baseline": 44.0}',
+    ])}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(r1))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(r2))
+
+    assert bench_history.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "headline" in out and "cfg2" in out and "—" in out
+    # -24.7%: 38.5 -> 29.0
+    assert "r01->r02: -24.7%" in out
+
+    assert bench_history.main(["--dir", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rounds"] == ["r01", "r02"]
+    cfg2 = {p["round"]: p["value"] for p in doc["series"]["cfg2"]}
+    assert cfg2 == {"r01": 8.6, "r02": None}
+    assert bench_history.main(
+        ["--dir", str(tmp_path), "--glob", "NOPE*.json"]) == 2

@@ -176,6 +176,30 @@ def test_plane_flush_lifecycle_spans():
     assert packs[0]["args"]["queued_ms"] >= 0
 
 
+def test_queued_ms_ignores_cross_clock_stamps():
+    """A submission stamped before a clock install (a simnet
+    enter/exit lands between submit and flush) must not difference two
+    clock domains: the stale stamp is skipped and queued_ms falls back
+    to 0 instead of an absurd virtual-minus-perf_counter delta."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import plane as vp
+
+    priv = PrivKey.generate(b"\x62" * 32)
+    msg = b"cross-clock"
+    rows = [(priv.pub_key(), msg, priv.sign(msg))]
+    p = vp.VerifyPlane(window_ms=0.5, use_device=False)
+    sub = vp._Submission(rows, None, 0, False)  # perf_counter domain
+    # a simnet-style virtual clock (ns since epoch) lands mid-queue
+    tracing.set_clock(lambda: 1_700_000_000_000_000_000)
+    try:
+        _, finish, _, _, led = p._stage([sub])
+        verdicts, _ = finish()
+    finally:
+        tracing.set_clock(None)
+    assert list(verdicts) == [True]
+    assert led[vp.FlushLedger.FIELDS.index("queued_ms")] == 0.0
+
+
 def test_consensus_step_metrics_and_instants(tmp_path):
     """A live single-validator node emits consensus.step instants and
     per-step duration observations while committing blocks."""
@@ -263,6 +287,95 @@ def test_trace_report_cli(tmp_path, capsys):
     assert trace_report.main([path, "--json"]) == 0
     rep = json.loads(capsys.readouterr().out)
     assert rep["stages"][0]["stage"] == "stage.a"
+
+
+def _write_trace(tmp_path, name, events):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _span_ev(name, ts, dur, **args):
+    ev = {"ph": "X", "name": name, "cat": "t", "ts": ts, "dur": dur,
+          "pid": 1, "tid": 0}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def test_trace_report_diff_flags_regressions(tmp_path, capsys):
+    """ISSUE 6 tentpole: --diff aligns two stage tables and flags the
+    stage whose mean grew past the thresholds, the stage that appeared,
+    and an overlap collapse (flights vanished = the plane degraded to
+    synchronous flushes)."""
+    from tools import trace_report
+
+    a = [_span_ev("plane.pack", i * 1000, 400) for i in range(8)]
+    a += [{"ph": "b", "name": "plane.flight", "id": str(i),
+           "ts": i * 1000 + 100, "pid": 1, "tid": 0} for i in range(8)]
+    a += [{"ph": "e", "name": "plane.flight", "id": str(i),
+           "ts": i * 1000 + 600, "pid": 1, "tid": 0} for i in range(8)]
+    b = [_span_ev("plane.pack", i * 1000, 900) for i in range(8)]
+    b += [_span_ev("plane.verify", i * 1000 + 900, 300)
+          for i in range(8)]
+    pa = _write_trace(tmp_path, "a.json", a)
+    pb = _write_trace(tmp_path, "b.json", b)
+
+    diff = trace_report.diff_report(
+        trace_report.stage_report(trace_report.load(pa)),
+        trace_report.stage_report(trace_report.load(pb)),
+    )
+    rows = {r["stage"]: r for r in diff["stages"]}
+    assert rows["plane.pack"]["flag"] == "REGRESSED"
+    assert rows["plane.pack"]["delta_mean_ms"] == pytest.approx(0.5)
+    assert rows["plane.verify"]["flag"] == "appeared"
+    assert diff["overlap"]["flag"] == "REGRESSED"  # flights 8 -> 0
+    assert "plane.pack" in diff["regressions"]
+    assert "pack_overlap_frac" in diff["regressions"]
+
+    # CLI: table mode exits 0, --fail-on-regression exits 1
+    assert trace_report.main(["--diff", pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "plane.pack" in out
+    assert trace_report.main(
+        ["--diff", pa, pb, "--fail-on-regression"]) == 1
+    capsys.readouterr()
+    # the reverse direction (B -> A) is pure improvement: pack shrank,
+    # the flights (and their overlap) came back — nothing flags, so
+    # --fail-on-regression exits 0
+    assert trace_report.main(
+        ["--diff", pb, pa, "--fail-on-regression", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["regressions"] == []
+    assert rep["overlap"]["flag"] == "improved"
+
+
+def test_trace_report_consensus_fallback(tmp_path, capsys):
+    """ISSUE 6 satellite: a trace with zero plane spans (consensus-only
+    run) must not crash or print an empty table — it falls back to the
+    per-step dwell table derived from consensus.step instants and says
+    so."""
+    from tools import trace_report
+
+    evs = []
+    steps = ["propose", "prevote", "precommit", "commit", "propose"]
+    for i, st in enumerate(steps):
+        evs.append({"ph": "i", "name": "consensus.step",
+                    "cat": "consensus", "ts": i * 500, "s": "t",
+                    "pid": 1, "tid": 0,
+                    "args": {"step": st, "height": 1, "round": 0}})
+    path = _write_trace(tmp_path, "c.json", evs)
+    rep = trace_report.stage_report(trace_report.load(path))
+    assert rep["fallback"]
+    names = [r["stage"] for r in rep["stages"]]
+    assert "step.propose" in names and "step.commit" in names
+    # each step dwelled one 500 us tick before the next instant
+    assert all(r["mean_ms"] == pytest.approx(0.5)
+               for r in rep["stages"])
+    assert trace_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "NOTE:" in out and "step.propose" in out
 
 
 # ---------------------------------------------------------------------------

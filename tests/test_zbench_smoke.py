@@ -1,0 +1,116 @@
+"""Tier-1 guard against bench.py rot (ISSUE 6 satellite).
+
+bench.py only runs on the driver's TPU host, so a broken fixture or a
+drifted API surfaced one round LATE — as a FAILED config in the next
+BENCH_rNN instead of a red test here. `--smoke` is the tier-1-safe
+slice: tiny shapes, host paths only, no jax import, seconds not
+minutes. This file (late in the alphabet on purpose: by the time it
+runs, the cheap unit tests have already localized any real breakage)
+drives the smoke run through main() exactly like the CLI would, then
+proves the --baseline comparator actually catches a regression by
+injecting a synthetic one.
+"""
+import json
+import sys
+
+import pytest
+
+import bench
+
+
+def _run(argv, capsys):
+    rc = bench.main(argv)
+    out = capsys.readouterr().out
+    lines = [json.loads(ln) for ln in out.strip().splitlines()
+             if ln.startswith("{")]
+    return rc, lines
+
+
+def test_bench_smoke_runs_host_only(tmp_path, capsys):
+    """The smoke slice completes in seconds, produces the JSON-line
+    shape every consumer (driver tails, load_bench_results, the
+    baseline comparator) parses, and never imports jax."""
+    jax_loaded_before = "jax" in sys.modules
+    out_path = tmp_path / "smoke.json"
+    rc, lines = _run(["--smoke", "--json-out", str(out_path)], capsys)
+    assert rc == 0
+    by_metric = {ln["metric"]: ln for ln in lines}
+    assert "smoke summary" in by_metric
+    assert by_metric["smoke summary"]["value"] == 3  # all configs ran
+    for ln in lines:
+        assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
+    # every smoke config produced a real number (no FAILED entries)
+    results = json.loads(out_path.read_text())["results"]
+    assert sorted(results) == ["cfg2_smoke", "cfg4_smoke", "cfg6_smoke"]
+    assert all(r["value"] is not None for r in results.values())
+    # the cfg6 miniature exercised the always-on flush ledger
+    assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
+    # the cfg4 miniature carries the disabled-path hook-cost proof row
+    dfp = results["cfg4_smoke"]["extra"]["disabled_flush_path"]
+    assert dfp["ledger_bookkeeping_us_per_flush"] > 0
+    # host-only contract: a smoke run must never pull in jax (tier-1
+    # budget); only check when this process hadn't loaded it already
+    if not jax_loaded_before:
+        assert "jax" not in sys.modules
+    # round-trip: the evidence file parses back per config
+    assert sorted(bench.load_bench_results(str(out_path))) == \
+        sorted(results)
+
+
+def test_bench_baseline_comparator_detects_injected_regression(
+        tmp_path, capsys):
+    """compare_to_baseline must FLAG a synthetic regression and stay
+    quiet against the run's own numbers — both through the real
+    --baseline/--fail-on-regression CLI path."""
+    base_path = tmp_path / "base.json"
+    rc, _ = _run(["--smoke", "--json-out", str(base_path)], capsys)
+    assert rc == 0
+
+    # clean compare: same host moments apart; a huge threshold keeps
+    # scheduler jitter from flaking tier-1 — the point is the exit code
+    # path, the sensitivity is proven below with a 20x injection
+    rc, lines = _run(["--smoke", "--baseline", str(base_path),
+                      "--baseline-threshold", "400",
+                      "--fail-on-regression"], capsys)
+    assert rc == 0
+    cmp_line = lines[-1]
+    assert cmp_line["metric"].startswith("baseline comparison")
+    assert cmp_line["extra"]["regressed"] == []
+
+    # inject: baseline claims cfg6 once did 10000x the throughput
+    # (unit sigs/sec, higher-better) and cfg2 ran 10000x faster (ms,
+    # lower-better) — BOTH directions must flag. The margin is huge on
+    # purpose: warm in-process reruns beat the cold first run by
+    # double-digit factors, and this test must never flake on that
+    doc = json.loads(base_path.read_text())
+    doc["results"]["cfg6_smoke"]["value"] *= 10_000
+    doc["results"]["cfg2_smoke"]["value"] /= 10_000
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps(doc))
+    rc, lines = _run(["--smoke", "--baseline", str(doctored),
+                      "--baseline-threshold", "400",
+                      "--fail-on-regression"], capsys)
+    assert rc == 1
+    flagged = lines[-1]["extra"]["regressed"]
+    assert "cfg6_smoke" in flagged and "cfg2_smoke" in flagged
+    rows = {r["config"]: r for r in lines[-1]["extra"]["rows"]}
+    assert rows["cfg6_smoke"]["status"] == "REGRESSED"
+    assert rows["cfg4_smoke"]["status"] == "ok"
+
+
+def test_compare_to_baseline_unit_directions():
+    """Direction table: ms down = improved, sigs/sec down = REGRESSED,
+    missing/failed configs are reported but never judged."""
+    cur = {"a": {"value": 50.0, "unit": "ms"},
+           "b": {"value": 50_000, "unit": "sigs/sec"},
+           "c": {"value": None, "unit": "ms"}}
+    base = {"a": {"value": 100.0, "unit": "ms"},
+            "b": {"value": 100_000, "unit": "sigs/sec"},
+            "d": {"value": 1.0, "unit": "x"}}
+    cmp_doc = bench.compare_to_baseline(cur, base, threshold_pct=30.0)
+    rows = {r["config"]: r for r in cmp_doc["rows"]}
+    assert rows["a"]["status"] == "improved"        # ms halved
+    assert rows["b"]["status"] == "REGRESSED"       # throughput halved
+    assert rows["b"]["delta_pct"] == pytest.approx(-50.0)
+    assert sorted(cmp_doc["missing"]) == ["c", "d"]
+    assert cmp_doc["regressed"] == ["b"] and not cmp_doc["ok"]

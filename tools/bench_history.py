@@ -1,0 +1,143 @@
+"""Render the bench trajectory r01 -> rNN from the checked-in
+BENCH_r*.json files.
+
+The driver records one BENCH_rNN.json per round (its ``tail`` holds the
+bench's JSON-line stdout, truncated at the head — early configs of old
+rounds may be missing; they render as ``—``, never guessed). This tool
+lines the rounds up per config so "did cfg4 ever recover" is one look
+at one table instead of five ``python -m json.tool`` sessions.
+
+Usage:
+    python tools/bench_history.py                 # table to stdout
+    python tools/bench_history.py --json          # machine-readable
+    python tools/bench_history.py --dir path/to/repo --glob 'BENCH_r*.json'
+"""
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import os
+import re
+import sys
+
+# direct script invocation puts tools/ on sys.path, not the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import load_bench_results  # noqa: E402
+
+# stable column order: the headline first, then the numbered configs
+_CFG_ORDER = re.compile(r"cfg(\d+)")
+
+
+def _cfg_key(name: str):
+    if name == "headline":
+        return (0, 0, name)
+    m = _CFG_ORDER.match(name)
+    return (1, int(m.group(1)) if m else 99, name)
+
+
+def collect(directory: str, pattern: str) -> dict:
+    """{round_tag: {cfg: result_dict}} for every matching BENCH file."""
+    rounds = {}
+    for path in sorted(globmod.glob(os.path.join(directory, pattern))):
+        tag = os.path.splitext(os.path.basename(path))[0]
+        tag = tag.replace("BENCH_", "")
+        try:
+            rounds[tag] = load_bench_results(path)
+        except (OSError, ValueError) as e:
+            rounds[tag] = {"_error": {"metric": "load failed",
+                                      "value": None, "unit": "",
+                                      "extra": {"error": repr(e)}}}
+    return rounds
+
+
+def history(rounds: dict) -> dict:
+    """Per-config series across rounds + headline deltas."""
+    configs = sorted({c for r in rounds.values() for c in r
+                      if not c.startswith("_")}, key=_cfg_key)
+    series = {}
+    for cfg in configs:
+        pts = []
+        for tag in rounds:
+            res = rounds[tag].get(cfg)
+            pts.append({
+                "round": tag,
+                "value": res.get("value") if res else None,
+                "unit": (res.get("unit") or "") if res else "",
+                "vs_baseline": res.get("vs_baseline") if res else None,
+            })
+        series[cfg] = pts
+    deltas = []
+    prev = None
+    for tag in rounds:
+        res = rounds[tag].get("headline") or {}
+        v = res.get("value")
+        if v is not None and prev is not None:
+            deltas.append({"from": prev[0], "to": tag,
+                           "delta_pct": round((v - prev[1]) / prev[1]
+                                              * 100.0, 1)})
+        if v is not None:
+            prev = (tag, v)
+    return {"rounds": list(rounds), "series": series,
+            "headline_deltas": deltas}
+
+
+def _fmt_val(pt: dict) -> str:
+    v = pt["value"]
+    if v is None:
+        return "—"
+    if isinstance(v, float) and v >= 1000:
+        v = round(v)
+    return f"{v:g}{(' ' + pt['unit']) if pt['unit'] else ''}"
+
+
+def render(hist: dict) -> str:
+    tags = hist["rounds"]
+    lines = []
+    width = max((len(c) for c in hist["series"]), default=8) + 2
+    colw = max(14, max((len(_fmt_val(p)) for pts in
+                        hist["series"].values() for p in pts),
+                       default=10) + 2)
+    lines.append("".join(["config".ljust(width)]
+                         + [t.ljust(colw) for t in tags]))
+    for cfg, pts in hist["series"].items():
+        lines.append("".join(
+            [cfg.ljust(width)] + [_fmt_val(p).ljust(colw) for p in pts]))
+    if hist["headline_deltas"]:
+        steps = ", ".join(f"{d['from']}->{d['to']}: "
+                          f"{d['delta_pct']:+.1f}%"
+                          for d in hist["headline_deltas"])
+        lines.append(f"headline trend: {steps}")
+    lines.append("('—' = config missing from that round's recorded "
+                 "tail — old tails are head-truncated, values are "
+                 "never guessed)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trajectory from checked-in BENCH files")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help="directory holding the "
+        "BENCH files (default: the repo root)")
+    ap.add_argument("--glob", default="BENCH_r*.json")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the history as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rounds = collect(args.dir, args.glob)
+    if not rounds:
+        print(f"no files match {args.glob} under {args.dir}",
+              file=sys.stderr)
+        return 2
+    hist = history(rounds)
+    if args.json:
+        print(json.dumps(hist, indent=1))
+    else:
+        print(render(hist))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

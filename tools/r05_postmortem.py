@@ -1,0 +1,181 @@
+"""The r05 regression post-mortem's reproducible instrument run.
+
+BENCH_r05 regressed every streaming config (cfg2 8.6->15.2 ms, cfg3
+110->416 ms, cfg4 121k->54k sigs/sec) while the headline improved.
+POSTMORTEM_r05.md holds the findings; this tool generates the
+measured half of the evidence on ANY host, TPU or not:
+
+1. **Per-flush fixed-overhead bound** — the r05 suspect-#1 question
+   ("did flush-path instrumentation eat the streaming configs?")
+   answered by measurement: the always-on ledger + disabled trace
+   hooks cost microseconds per flush (bench.disabled_flush_bookkeeping_us),
+   orders of magnitude under the ms-scale regressions.
+2. **Stage-delta tables from real traces** — two traced runs of the
+   verify plane's flush pipeline (host path, so it runs in the CPU
+   container) with IDENTICAL flush composition: "r05-repro" carries a
+   controlled 2 ms/flush overhead injected through the
+   `verifyplane.dispatch` failpoint (the exact regression an
+   instrumentation bug on the flush path would cause), "fixed" is the
+   shipped code. `trace_report.diff_report` aligns them — the same
+   tables `--diff` produces for cfg2/cfg4 traces on the TPU host —
+   pinpointing the overhead to the pack stage and showing the
+   recovery; the flush ledger summarizes both runs.
+3. **Per-flush amortization bound** — the same workload serialized
+   (one flush per row) vs coalesced (one flush per burst), bounding
+   the whole per-flush fixed cost from real traces.
+
+Usage:
+    python tools/r05_postmortem.py [--sigs 64] [--json] \
+        [--trace-out PREFIX]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import disabled_flush_bookkeeping_us  # noqa: E402
+from tools import trace_report  # noqa: E402
+
+
+def _plane_run(n_sigs: int, serialized: bool,
+               inject_per_flush_s: float = 0.0):
+    """One traced verify-plane run (host path). Returns (trace events,
+    ledger summary, wall_ms)."""
+    import time
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.libs import failpoints as fp
+    from cometbft_tpu.libs import tracing
+    from cometbft_tpu.verifyplane import VerifyPlane
+
+    keys = [PrivKey.generate((8100 + i).to_bytes(4, "big") + b"\x77" * 28)
+            for i in range(n_sigs)]
+    subs = [(k.pub_key(), b"pm-%d" % i, k.sign(b"pm-%d" % i))
+            for i, k in enumerate(keys)]
+    plane = VerifyPlane(window_ms=2.0, use_device=False)
+    plane.start()
+    tracing.enable(capacity=1 << 16)
+    if inject_per_flush_s:
+        # the r05-repro regime: a controlled per-flush overhead on the
+        # dispatch path — exactly what a flush-path instrumentation
+        # regression would add. delay (unlike raise) keeps the verdict
+        # path identical; only the per-flush cost moves. The ARMED/
+        # FIRED warnings are deliberate instrumentation here, not a
+        # fault under debug — keep the output to the tables.
+        import logging
+
+        logging.getLogger("cometbft_tpu.libs.failpoints").setLevel(
+            logging.ERROR)
+        fp.arm("verifyplane.dispatch", "delay", inject_per_flush_s)
+    try:
+        t0 = time.perf_counter()
+        if serialized:
+            # one flush per row: per-flush fixed costs paid n_sigs
+            # times (the amplification regime)
+            for p, m, s in subs:
+                assert plane.submit(p, m, s).result(10) == (True,)
+        else:
+            # the window coalesces the burst into few flushes
+            futs = [plane.submit(p, m, s) for p, m, s in subs]
+            assert all(all(f.result(10)) for f in futs)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        events = tracing.export_chrome()["traceEvents"]
+    finally:
+        if inject_per_flush_s:
+            fp.disarm("verifyplane.dispatch")
+        tracing.disable()
+        plane.stop()
+    return events, plane.ledger.summary(), wall_ms
+
+
+def run(n_sigs: int = 64, trace_out: str = "",
+        inject_ms: float = 2.0) -> dict:
+    # (1) r05-repro vs fixed: identical workload + flush composition,
+    # the repro side carrying inject_ms of per-flush overhead
+    ev_r, led_r, wall_r = _plane_run(n_sigs, serialized=True,
+                                     inject_per_flush_s=inject_ms / 1e3)
+    ev_f, led_f, wall_f = _plane_run(n_sigs, serialized=True)
+    # (2) amortization bound: the same work coalesced into few flushes
+    ev_c, led_c, wall_c = _plane_run(n_sigs, serialized=False)
+    if trace_out:
+        for tag, ev in (("r05repro", ev_r), ("fixed", ev_f),
+                        ("coalesced", ev_c)):
+            with open(f"{trace_out}.{tag}.trace.json", "w") as f:
+                json.dump({"traceEvents": ev}, f)
+    rep_r = trace_report.stage_report(ev_r)
+    rep_f = trace_report.stage_report(ev_f)
+    rep_c = trace_report.stage_report(ev_c)
+    # A = r05-repro, B = fixed: the recovery table ("where did the ms
+    # go" — the pack stage gives inject_ms back per flush); the reverse
+    # direction is what the regression looked like when it landed
+    diff_recovery = trace_report.diff_report(rep_r, rep_f)
+    diff_regression = trace_report.diff_report(rep_f, rep_r)
+
+    def per_flush(rep, led):
+        tot = sum(r["total_ms"] for r in rep["stages"]
+                  if r["stage"].startswith("plane."))
+        return round(tot / max(1, led["flushes"]), 4)
+
+    return {
+        "workload": {"sigs": n_sigs, "path": "host (no accelerator)",
+                     "injected_per_flush_ms": inject_ms,
+                     "wall_ms_r05repro": round(wall_r, 1),
+                     "wall_ms_fixed": round(wall_f, 1),
+                     "wall_ms_coalesced": round(wall_c, 1)},
+        "hook_cost_us": disabled_flush_bookkeeping_us(k=5000),
+        "stage_tables": {"r05repro": rep_r["stages"],
+                         "fixed": rep_f["stages"],
+                         "coalesced": rep_c["stages"]},
+        "diff_recovery": diff_recovery,
+        "diff_regression": diff_regression,
+        "ledger": {"r05repro": led_r, "fixed": led_f,
+                   "coalesced": led_c},
+        "per_flush_host_ms": {"fixed_serialized": per_flush(rep_f,
+                                                            led_f),
+                              "coalesced": per_flush(rep_c, led_c)},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="r05 post-mortem instrument run (host-path)")
+    ap.add_argument("--sigs", type=int, default=64)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--trace-out", default="",
+                    help="also write PREFIX.{r05repro,fixed,coalesced}"
+                         ".trace.json")
+    ap.add_argument("--inject-ms", type=float, default=2.0,
+                    help="per-flush overhead injected into the "
+                         "r05-repro run (default 2.0)")
+    args = ap.parse_args(argv)
+    doc = run(args.sigs, args.trace_out, args.inject_ms)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+    hc = doc["hook_cost_us"]
+    print(f"workload: {doc['workload']}")
+    print(f"suspect-#1 hook cost (tracing disabled): "
+          f"{hc['ledger_bookkeeping_us_per_flush']} us ledger + "
+          f"{hc['disabled_span_us_per_call']} us span, per flush")
+    print(f"per-flush host cost (fixed code): "
+          f"{doc['per_flush_host_ms']['fixed_serialized']} ms at "
+          f"{doc['ledger']['fixed']['flushes']} flushes (1 row each) "
+          f"vs {doc['per_flush_host_ms']['coalesced']} ms at "
+          f"{doc['ledger']['coalesced']['flushes']} flush(es) "
+          f"coalesced")
+    print()
+    print(trace_report.format_diff(doc["diff_recovery"], "r05-repro",
+                                   "fixed"))
+    print()
+    regs = doc["diff_regression"]["regressions"]
+    print(f"reverse direction (fixed -> r05-repro) flags: {regs}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
-"""Turn a Chrome trace file (libs/tracing.py export) into a per-stage
-critical-path table.
+"""Turn Chrome trace files (libs/tracing.py export) into per-stage
+critical-path tables — and DIFF two of them.
 
 The perf loop's before/after instrument: run a workload with tracing on
 (``bench.py --trace-out``, ``[tracing] enable``, or
@@ -8,8 +8,21 @@ wall time went per stage — pack vs device flight vs collect vs settle
 for the verify plane, per-step time for consensus, fsync cost for the
 WAL. BENCH_*.json embeds the same table via ``stage_report``.
 
+Differencing is the regression instrument (ISSUE 6 / ROADMAP open item
+1): ``--diff A.trace.json B.trace.json`` aligns the two stage tables
+and emits stage-delta and overlap-delta rows with regression flags, so
+"where did cfg2's 6.6 ms go" is one command instead of an eyeballing
+exercise.
+
+Traces with no verify-plane spans (blocksync-/consensus-only runs)
+fall back to a consensus-step table derived from the ``consensus.step``
+instants, and the report says so.
+
 Usage:
     python tools/trace_report.py trace.json [--json]
+    python tools/trace_report.py --diff A.trace.json B.trace.json \
+        [--json] [--threshold-pct 10] [--threshold-ms 0.05] \
+        [--fail-on-regression]
 """
 from __future__ import annotations
 
@@ -20,7 +33,13 @@ from typing import Dict, List, Optional
 # verify-plane flush pipeline, in submission order: the critical-path
 # section reports these stages first and computes pack/flight overlap
 PLANE_STAGES = ("plane.pack", "plane.flight", "plane.collect",
-                "plane.settle")
+                "plane.verify", "plane.settle")
+
+# diff thresholds: a stage only flags when it moved by BOTH the
+# relative and the absolute floor (one guards noise on tiny stages, the
+# other on huge-but-stable ones)
+DEFAULT_THRESHOLD_PCT = 10.0
+DEFAULT_THRESHOLD_MS = 0.05
 
 
 def load(path: str) -> List[dict]:
@@ -57,6 +76,37 @@ def _overlap_us(span: tuple, intervals: List[tuple]) -> float:
                for a, b in intervals if b > lo and a < hi)
 
 
+def _consensus_step_durations(events: List[dict]) -> Dict[str, List[float]]:
+    """Per-step dwell times (us) reconstructed from ``consensus.step``
+    instants: each instant marks ENTERING a step, so a step's duration
+    is the gap to the next step instant on the same thread. The open
+    tail (last instant per thread) has no end and is dropped."""
+    by_tid: Dict[int, List[tuple]] = {}
+    for e in events:
+        if e.get("ph") == "i" and e.get("name") == "consensus.step":
+            step = (e.get("args") or {}).get("step", "?")
+            by_tid.setdefault(e.get("tid", 0), []).append(
+                (e["ts"], str(step)))
+    out: Dict[str, List[float]] = {}
+    for seq in by_tid.values():
+        seq.sort(key=lambda p: p[0])
+        for (t0, step), (t1, _) in zip(seq, seq[1:]):
+            out.setdefault(f"step.{step}", []).append(t1 - t0)
+    return out
+
+
+def _row(name: str, durs: List[float]) -> dict:
+    return {
+        "stage": name,
+        "count": len(durs),
+        "total_ms": round(sum(durs) / 1000.0, 3),
+        "mean_ms": round(sum(durs) / len(durs) / 1000.0, 4)
+        if durs else 0.0,
+        "p50_ms": round(_pct(durs, 0.5) / 1000.0, 4),
+        "max_ms": round(max(durs) / 1000.0, 4) if durs else 0.0,
+    }
+
+
 def stage_report(events: List[dict]) -> dict:
     """Aggregate a trace into {stages, instants, plane} — the table the
     bench embeds and main() pretty-prints.
@@ -66,6 +116,9 @@ def stage_report(events: List[dict]) -> dict:
     plane: flush-pipeline extras — flight count/total from the async
     b/e pairs and the fraction of pack time hidden behind an airborne
     flight (the double-buffer overlap the dispatcher exists to win).
+    fallback: set (with a human note) when the trace holds no
+    verify-plane spans and the stage table was derived from the
+    consensus-step instants instead.
     """
     spans: Dict[str, List[float]] = {}
     instants: Dict[str, int] = {}
@@ -80,23 +133,25 @@ def stage_report(events: List[dict]) -> dict:
             instants[e["name"]] = instants.get(e["name"], 0) + 1
     flights = _flight_intervals(events)
 
-    def row(name: str, durs: List[float]) -> dict:
-        return {
-            "stage": name,
-            "count": len(durs),
-            "total_ms": round(sum(durs) / 1000.0, 3),
-            "mean_ms": round(sum(durs) / len(durs) / 1000.0, 4)
-            if durs else 0.0,
-            "p50_ms": round(_pct(durs, 0.5) / 1000.0, 4),
-            "max_ms": round(max(durs) / 1000.0, 4) if durs else 0.0,
-        }
+    fallback = None
+    if not any(n in spans for n in PLANE_STAGES):
+        # consensus-/blocksync-only trace: no flush pipeline to report.
+        # Fall back to the per-step dwell table so the report is never
+        # empty on a trace that plainly recorded consensus activity.
+        steps = _consensus_step_durations(events)
+        if steps:
+            for name, durs in steps.items():
+                spans.setdefault(name, durs)
+            fallback = ("no verify-plane spans in this trace; stage "
+                        "table includes consensus-step dwell times "
+                        "derived from consensus.step instants")
 
     # plane stages first (pipeline order), then everything else by
     # total time descending — the critical path reads top-down
     ordered = [n for n in PLANE_STAGES if n in spans]
     rest = sorted((n for n in spans if n not in PLANE_STAGES),
                   key=lambda n: -sum(spans[n]))
-    stages = [row(n, spans[n]) for n in ordered + rest]
+    stages = [_row(n, spans[n]) for n in ordered + rest]
 
     plane: Optional[dict] = None
     if flights or pack_spans:
@@ -112,13 +167,125 @@ def stage_report(events: List[dict]) -> dict:
             if pack_total else 0.0,
         }
     return {"stages": stages, "instants": instants, "plane": plane,
-            "events": len(events)}
+            "events": len(events), "fallback": fallback}
+
+
+# --------------------------------------------------------------------------
+# differencing
+# --------------------------------------------------------------------------
+
+
+def diff_report(rep_a: dict, rep_b: dict,
+                threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+                threshold_ms: float = DEFAULT_THRESHOLD_MS) -> dict:
+    """Align two stage_report outputs (A = before, B = after) into
+    stage-delta rows + an overlap-delta block with regression flags.
+
+    A stage REGRESSED when its mean grew by more than BOTH thresholds
+    (relative + absolute); it improved when it shrank by the same
+    margin. Stages present on only one side are flagged too (appeared
+    = new cost, vanished = cost removed or stage renamed)."""
+    a_by = {r["stage"]: r for r in rep_a.get("stages", [])}
+    b_by = {r["stage"]: r for r in rep_b.get("stages", [])}
+    order = [r["stage"] for r in rep_a.get("stages", [])]
+    order += [s for s in (r["stage"] for r in rep_b.get("stages", []))
+              if s not in a_by]
+
+    def flag_of(ma: float, mb: float) -> str:
+        d = mb - ma
+        if abs(d) < threshold_ms:
+            return ""
+        if ma > 0 and abs(d) / ma * 100.0 < threshold_pct:
+            return ""
+        return "REGRESSED" if d > 0 else "improved"
+
+    rows = []
+    for name in order:
+        ra, rb = a_by.get(name), b_by.get(name)
+        if ra is None or rb is None:
+            rows.append({
+                "stage": name,
+                "flag": "appeared" if ra is None else "vanished",
+                "count_a": ra["count"] if ra else 0,
+                "count_b": rb["count"] if rb else 0,
+                "mean_ms_a": ra["mean_ms"] if ra else 0.0,
+                "mean_ms_b": rb["mean_ms"] if rb else 0.0,
+                "total_ms_a": ra["total_ms"] if ra else 0.0,
+                "total_ms_b": rb["total_ms"] if rb else 0.0,
+                "delta_mean_ms": round(
+                    (rb["mean_ms"] if rb else 0.0)
+                    - (ra["mean_ms"] if ra else 0.0), 4),
+                "delta_total_ms": round(
+                    (rb["total_ms"] if rb else 0.0)
+                    - (ra["total_ms"] if ra else 0.0), 3),
+                "delta_pct": None,
+            })
+            continue
+        d_mean = rb["mean_ms"] - ra["mean_ms"]
+        rows.append({
+            "stage": name,
+            "flag": flag_of(ra["mean_ms"], rb["mean_ms"]),
+            "count_a": ra["count"], "count_b": rb["count"],
+            "mean_ms_a": ra["mean_ms"], "mean_ms_b": rb["mean_ms"],
+            "total_ms_a": ra["total_ms"], "total_ms_b": rb["total_ms"],
+            "delta_mean_ms": round(d_mean, 4),
+            "delta_total_ms": round(rb["total_ms"] - ra["total_ms"], 3),
+            "delta_pct": round(d_mean / ra["mean_ms"] * 100.0, 1)
+            if ra["mean_ms"] else None,
+        })
+
+    overlap = None
+    pa, pb = rep_a.get("plane"), rep_b.get("plane")
+    if pa or pb:
+        fa = (pa or {}).get("pack_overlap_frac", 0.0)
+        fb = (pb or {}).get("pack_overlap_frac", 0.0)
+        overlap = {
+            "pack_overlap_frac_a": fa,
+            "pack_overlap_frac_b": fb,
+            "delta": round(fb - fa, 3),
+            "flights_a": (pa or {}).get("flights", 0),
+            "flights_b": (pb or {}).get("flights", 0),
+            "flight_total_ms_a": (pa or {}).get("flight_total_ms", 0.0),
+            "flight_total_ms_b": (pb or {}).get("flight_total_ms", 0.0),
+            # losing overlap means pack time stopped hiding behind the
+            # device — the double buffer stopped paying. Flights
+            # vanishing entirely is the worst case of that (the plane
+            # degraded to synchronous/host flushes).
+            "flag": "REGRESSED"
+            if (fb < fa - 0.05
+                or ((pa or {}).get("flights", 0) > 0
+                    and not (pb or {}).get("flights", 0)))
+            else ("improved" if fb > fa + 0.05 else ""),
+        }
+
+    # an appeared stage is only a REGRESSION when its new cost clears
+    # the absolute threshold — a trivial span the before-run happened
+    # not to hit must not fail a --fail-on-regression CI gate
+    regressions = [r["stage"] for r in rows
+                   if r["flag"] == "REGRESSED"
+                   or (r["flag"] == "appeared"
+                       and r["mean_ms_b"] >= threshold_ms)]
+    if overlap and overlap["flag"] == "REGRESSED":
+        regressions.append("pack_overlap_frac")
+    notes = [n for n in (rep_a.get("fallback"), rep_b.get("fallback"))
+             if n]
+    return {"stages": rows, "overlap": overlap,
+            "regressions": regressions, "notes": notes,
+            "events_a": rep_a.get("events", 0),
+            "events_b": rep_b.get("events", 0)}
+
+
+# --------------------------------------------------------------------------
+# formatting
+# --------------------------------------------------------------------------
 
 
 def format_report(rep: dict) -> str:
-    lines = [f"{rep['events']} trace events",
-             "", f"{'stage':<26}{'count':>7}{'total ms':>11}"
-                 f"{'mean ms':>10}{'p50 ms':>10}{'max ms':>10}"]
+    lines = [f"{rep['events']} trace events"]
+    if rep.get("fallback"):
+        lines.append(f"NOTE: {rep['fallback']}")
+    lines += ["", f"{'stage':<26}{'count':>7}{'total ms':>11}"
+                  f"{'mean ms':>10}{'p50 ms':>10}{'max ms':>10}"]
     for r in rep["stages"]:
         lines.append(f"{r['stage']:<26}{r['count']:>7}"
                      f"{r['total_ms']:>11.3f}{r['mean_ms']:>10.4f}"
@@ -137,14 +304,73 @@ def format_report(rep: dict) -> str:
     return "\n".join(lines)
 
 
+def format_diff(diff: dict, path_a: str = "A", path_b: str = "B") -> str:
+    lines = [f"stage-delta: {path_a} ({diff['events_a']} events) -> "
+             f"{path_b} ({diff['events_b']} events)"]
+    for n in diff.get("notes", []):
+        lines.append(f"NOTE: {n}")
+    lines += ["", f"{'stage':<22}{'cnt A':>6}{'cnt B':>6}"
+                  f"{'mean A':>9}{'mean B':>9}{'Δ ms':>9}{'Δ %':>8}"
+                  f"  {'flag'}"]
+    for r in diff["stages"]:
+        pct = f"{r['delta_pct']:+.1f}" if r["delta_pct"] is not None \
+            else "-"
+        lines.append(
+            f"{r['stage']:<22}{r['count_a']:>6}{r['count_b']:>6}"
+            f"{r['mean_ms_a']:>9.4f}{r['mean_ms_b']:>9.4f}"
+            f"{r['delta_mean_ms']:>+9.4f}{pct:>8}  {r['flag']}")
+    if diff["overlap"]:
+        o = diff["overlap"]
+        lines += ["",
+                  f"overlap-delta: pack_overlap_frac "
+                  f"{o['pack_overlap_frac_a']:.3f} -> "
+                  f"{o['pack_overlap_frac_b']:.3f} (Δ {o['delta']:+.3f})"
+                  f" flights {o['flights_a']}->{o['flights_b']}"
+                  + (f"  {o['flag']}" if o["flag"] else "")]
+    lines += ["", ("regressions: " + ", ".join(diff["regressions"])
+                   if diff["regressions"] else "no regressions flagged")]
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="per-stage critical-path table from a Chrome trace")
-    ap.add_argument("trace", help="trace file (libs/tracing export)")
+        description="per-stage critical-path table from a Chrome trace, "
+                    "or a stage-delta diff of two traces")
+    ap.add_argument("traces", nargs="+",
+                    help="trace file(s) (libs/tracing export); two "
+                         "files with --diff")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two traces: stage-delta + overlap-delta "
+                         "tables with regression flags")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of a table")
+    ap.add_argument("--threshold-pct", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="relative regression floor (mean ms, %%)")
+    ap.add_argument("--threshold-ms", type=float,
+                    default=DEFAULT_THRESHOLD_MS,
+                    help="absolute regression floor (mean ms)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when the diff flags any regression")
     args = ap.parse_args(argv)
-    rep = stage_report(load(args.trace))
+    if args.fail_on_regression and not args.diff:
+        # only a diff can flag regressions; a gate wired without --diff
+        # would be permanently green
+        ap.error("--fail-on-regression requires --diff")
+    if args.diff:
+        if len(args.traces) != 2:
+            ap.error("--diff needs exactly two trace files")
+        rep_a = stage_report(load(args.traces[0]))
+        rep_b = stage_report(load(args.traces[1]))
+        diff = diff_report(rep_a, rep_b, args.threshold_pct,
+                           args.threshold_ms)
+        print(json.dumps(diff) if args.json
+              else format_diff(diff, args.traces[0], args.traces[1]))
+        return 1 if args.fail_on_regression and diff["regressions"] \
+            else 0
+    if len(args.traces) != 1:
+        ap.error("exactly one trace file (or use --diff A B)")
+    rep = stage_report(load(args.traces[0]))
     print(json.dumps(rep) if args.json else format_report(rep))
     return 0
 
