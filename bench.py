@@ -101,7 +101,7 @@ class CompileWatch:
 
 # units where a LARGER value is better; everything else (ms) is
 # smaller-is-better
-BETTER_HIGHER_UNITS = ("sigs/sec", "x")
+BETTER_HIGHER_UNITS = ("sigs/sec", "tx/s", "x")
 BASELINE_THRESHOLD_PCT = 30.0  # tunnel noise floor; see WALL_RUNS note
 
 
@@ -961,6 +961,56 @@ def cfg8_multichip_smoke(n_sigs=64):
     }
 
 
+def cfg9_sustained(rate=120.0, duration=45.0, n_nodes=4):
+    """#9: sustained open-loop throughput — the ROADMAP item-5 metric.
+
+    An in-process LocalNetwork commits blocks while node 0 eats an
+    open-loop signed-tx flood through broadcast_tx (admission control +
+    sigtx verification on the BULK lane of a running verify plane).
+    Open-loop (tools/loadtime discipline): injections fire at fixed
+    target times regardless of response latency, so overload shows up
+    as queueing delay and explicit OVERLOADED verdicts instead of the
+    generator politely backing off. Reports accepted tx/s + commits/s
+    over the window and the per-lane submit-to-result p99s — the
+    numbers the chaos-soak test bounds (zero CONSENSUS sheds, vote p99
+    within 2x no-flood) are REPORTED here so --baseline can watch the
+    sustained story drift release-over-release."""
+    from tools.loadtime import run_inprocess
+
+    rep = run_inprocess(rate, duration, n_nodes=n_nodes, signed=True,
+                        plane=True)
+    lane_waits = (rep.get("plane") or {}).get("lane_waits", {})
+    sheds = (rep.get("plane") or {}).get("sheds", {})
+    cons = lane_waits.get("consensus", {})
+    bulk = lane_waits.get("bulk", {})
+    return {
+        "metric": "cfg9 sustained open-loop throughput",
+        "value": rep["accepted_tx_per_s"],
+        "unit": "tx/s",
+        "vs_baseline": None,
+        "extra": {
+            "nodes": n_nodes,
+            "offered_tx_per_s": rep["offered_tx_per_s"],
+            "duration_s": rep["wall_s"],
+            "commits": rep["commits"],
+            "commits_per_s": rep["commits_per_s"],
+            "accepted": rep["accepted"],
+            "overloaded": rep["overloaded"],
+            "rejected_other": rep["rejected_other"],
+            "late_injections": rep["late_injections"],
+            "checktx_p50_ms": rep["checktx_latency"].get("p50_ms"),
+            "checktx_p99_ms": rep["checktx_latency"].get("p99_ms"),
+            "vote_submit_p99_ms": cons.get("p99_ms"),
+            "bulk_submit_p99_ms": bulk.get("p99_ms"),
+            "consensus_sheds": sheds.get("consensus"),
+            "bulk_sheds": sheds.get("bulk"),
+            "admission": rep.get("admission"),
+            "note": "open-loop signed flood vs a live committing net; "
+                    "QoS invariants asserted in tests/test_soak.py",
+        },
+    }
+
+
 def headline_10k():
     """The driver metric: 10k-validator VerifyCommitLight fused p50."""
     vs, commit, bid = make_ed_commit(10_000)
@@ -1142,7 +1192,8 @@ def main(argv=None):
                      ("cfg5", cfg5_light_secp),
                      ("cfg6", cfg6_vote_plane),
                      ("cfg7", cfg7_pack_only),
-                     ("cfg8", cfg8_multichip_smoke)]:
+                     ("cfg8", cfg8_multichip_smoke),
+                     ("cfg9", cfg9_sustained)]:
         traced = bool(args.trace_out) and name in TRACED_CONFIGS
         if traced:
             tracing.enable(capacity=1 << 18)

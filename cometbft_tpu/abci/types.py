@@ -14,6 +14,17 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 CODE_TYPE_OK = 0
+# Non-OK CheckTx codes the NODE itself (not the app) may answer with.
+# The reference leaves code semantics to the app; these two sit far
+# above the small codes sample apps use so they can never collide.
+# OVERLOADED is the explicit load-shed verdict: admission control
+# fast-rejected the tx, or the verify plane shed its BULK-lane
+# signature check past the deadline. The log carries a
+# `retry_after_ms=N` hint (the Retry-After analog for JSON-RPC).
+CODE_TYPE_OVERLOADED = 1001
+# the node-side signature pre-check (mempool sigtx envelope) failed —
+# the tx never reached the app
+CODE_TYPE_BAD_SIGNATURE = 1002
 
 
 @dataclass
@@ -97,6 +108,11 @@ class ResponseCheckTx:
     log: str = ""
     gas_wanted: int = 0
     gas_used: int = 0
+    # structured backoff hint for CODE_TYPE_OVERLOADED responses (0 =
+    # none): the machine-readable source for the RPC layer's
+    # `retry_after_ms` field — the log carries the same number for
+    # humans, but clients must never have to parse it out of a string
+    retry_after_ms: float = 0.0
 
 
 @dataclass

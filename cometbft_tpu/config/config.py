@@ -52,6 +52,38 @@ class MempoolConfig:
     size: int = 5000
     cache_size: int = 10000
     recheck: bool = True
+    # node-side sigtx envelope verification through the verify plane's
+    # BULK lane (mempool/sigtx.py); unsigned txs are unaffected
+    verify_sigs: bool = True
+    # CheckTx admission control (mempool/admission.py); `admission =
+    # false` removes the gate entirely (every CheckTx runs)
+    admission: bool = True
+    max_inflight_checktx: int = 64
+    # tightened in-flight bound while the device breaker is OPEN (all
+    # verification is on the 1-core host then)
+    breaker_inflight_checktx: int = 8
+    # pool-fill watermarks with hysteresis: fast-reject broadcast_tx at
+    # high, resume below low
+    high_watermark: float = 0.9
+    low_watermark: float = 0.7
+    # backoff hint attached to OVERLOADED responses (Retry-After analog)
+    retry_after_ms: float = 500.0
+
+    def build_admission(self, fill_fn=None, breaker_open_fn=None):
+        """An AdmissionController per this config, or None when the
+        gate is disabled."""
+        if not self.admission:
+            return None
+        from cometbft_tpu.mempool.admission import AdmissionController
+
+        return AdmissionController(
+            max_inflight=self.max_inflight_checktx,
+            breaker_inflight=self.breaker_inflight_checktx,
+            high_watermark=self.high_watermark,
+            low_watermark=self.low_watermark,
+            retry_after_ms=self.retry_after_ms,
+            fill_fn=fill_fn, breaker_open_fn=breaker_open_fn,
+        )
 
 
 @dataclass
@@ -113,7 +145,15 @@ class VerifyPlaneConfig:
     enable: bool = False
     window_ms: float = 1.5      # micro-batch deadline (added latency cap)
     max_batch: int = 1024       # flush early at this many pending rows
-    max_queue: int = 8192       # backpressure above this many rows
+    max_queue: int = 8192       # CONSENSUS-lane backpressure bound
+    # QoS BULK lane (mempool CheckTx, backfill): its own coalescing
+    # window (bulk favors batch fullness over latency; 0 = 4x window_ms),
+    # queue bound (0 = max_queue), and shed deadline — a BULK submission
+    # older than this is answered with an explicit Overloaded verdict
+    # (0 disables deadline shedding)
+    bulk_window_ms: float = 0.0
+    bulk_max_queue: int = 0
+    bulk_deadline_ms: float = 250.0
 
     def build(self, metrics=None):
         """A VerifyPlane per this config, or None when disabled."""
@@ -121,9 +161,14 @@ class VerifyPlaneConfig:
             return None
         from cometbft_tpu.verifyplane import VerifyPlane
 
-        return VerifyPlane(window_ms=self.window_ms,
-                           max_batch=self.max_batch,
-                           max_queue=self.max_queue, metrics=metrics)
+        return VerifyPlane(
+            window_ms=self.window_ms,
+            max_batch=self.max_batch,
+            max_queue=self.max_queue, metrics=metrics,
+            bulk_window_ms=self.bulk_window_ms or None,
+            bulk_max_queue=self.bulk_max_queue or None,
+            bulk_deadline_ms=self.bulk_deadline_ms,
+        )
 
 
 @dataclass
@@ -204,6 +249,24 @@ class Config:
         if self.verify_plane.max_queue < self.verify_plane.max_batch:
             raise ConfigError(
                 "[verify_plane] max_queue must be >= max_batch")
+        for name in ("bulk_window_ms", "bulk_max_queue",
+                     "bulk_deadline_ms"):
+            if getattr(self.verify_plane, name) < 0:
+                raise ConfigError(f"[verify_plane] {name} must be >= 0")
+        mp = self.mempool
+        if mp.size < 1:
+            raise ConfigError("[mempool] size must be >= 1")
+        if mp.max_inflight_checktx < 1 or mp.breaker_inflight_checktx < 1:
+            raise ConfigError(
+                "[mempool] inflight CheckTx bounds must be >= 1")
+        if not 0.0 < mp.high_watermark <= 1.0:
+            raise ConfigError(
+                "[mempool] high_watermark must be in (0, 1]")
+        if not 0.0 <= mp.low_watermark <= mp.high_watermark:
+            raise ConfigError(
+                "[mempool] low_watermark must be in [0, high_watermark]")
+        if mp.retry_after_ms < 0:
+            raise ConfigError("[mempool] retry_after_ms must be >= 0")
         if self.tracing.buffer < 16:
             raise ConfigError("[tracing] buffer must be >= 16 events")
         if self.failpoints.spec:

@@ -157,6 +157,9 @@ class ConsensusState(BaseService):
         self.on_evidence: Optional[Callable] = None
         # observability (consensus/metrics.go:24-91 analog); set by Node
         self.metrics = None
+        # votes dropped by the cheap pre-WAL admission filter (the
+        # garbage-flood shield; see _vote_prefilter)
+        self.prefilter_drops = 0
         self._last_commit_walltime = 0.0
         self._step_entered_at = 0.0  # real-clock step-duration anchor
         # set when a SimulatedCrash failpoint killed the machine
@@ -310,6 +313,19 @@ class ConsensusState(BaseService):
 
     def _handle(self, item, write_wal: bool) -> None:
         kind = item[0]
+        if kind == "vote" and not self._vote_prefilter(item[1].vote):
+            self._count_prefilter_drop(item[1].vote)
+            # overload shield: a vote that fails the CHEAP stateless +
+            # valset checks (unknown index, address mismatch, wrong
+            # height, no signature) is dropped BEFORE the WAL write —
+            # pre-filtered garbage must never cost an fsync. A flood of
+            # forged votes otherwise turns the consensus WAL into a
+            # disk-bandwidth DoS (the mempool_time hammer scenario:
+            # ~6k garbage votes/sec × one fsync each starves real
+            # consensus traffic on a 1-core host). Signature-valid
+            # admission still happens in VoteSet.add_vote; this only
+            # skips votes the handler would drop anyway.
+            return
         if write_wal and self.wal:
             self._wal_write(item)
         if kind == "start_round":
@@ -719,6 +735,44 @@ class ConsensusState(BaseService):
         # being processed (state.go:2452 signAddVote -> sendInternalMessage)
         self.internal_queue.put(("vote", VoteMsg(vote)))
         self.broadcast(("vote", vote))
+
+    # prefilter drop bookkeeping: under a garbage flood the per-vote
+    # warning itself is overload (log handlers + pytest capture cost
+    # more than the drop) — log a rate-limited summary instead
+    _PREFILTER_LOG_EVERY = 512
+
+    def _vote_prefilter(self, vote: Vote) -> bool:
+        """Cheap admission: False = drop before any WAL/verify cost.
+        Only rejects votes _try_add_vote/VoteSet would reject anyway —
+        wrong height, structurally empty signature, unknown validator
+        index, or index/address mismatch against this height's valset.
+        No signature verification happens here. Runs on the receive
+        routine; reads of height/valset race benignly with round
+        transitions (a misjudged vote is re-gossiped/retransmitted)."""
+        try:
+            if vote.height != self.height:
+                return False
+            if not vote.signature or vote.validator_index < 0:
+                return False
+            vals = self.round_validators or self.state.validators
+            val = vals.get_by_index(vote.validator_index)
+            if val is None or val.address != vote.validator_address:
+                return False
+            return True
+        except Exception:  # noqa: BLE001 - racing state: let it through
+            return True
+
+    def _count_prefilter_drop(self, vote: Vote) -> None:
+        self.prefilter_drops += 1
+        if self.metrics is not None:
+            self.metrics.invalid_votes.inc()
+        if self.prefilter_drops % self._PREFILTER_LOG_EVERY == 1:
+            _log.warning(
+                "vote prefilter dropped %d invalid votes so far "
+                "(latest: h=%d from %s; summary log, rate-limited)",
+                self.prefilter_drops, vote.height,
+                vote.validator_address.hex()[:12],
+            )
 
     def _try_add_vote(self, vote: Vote, from_replay: bool = False) -> None:
         """state.go:2110 tryAddVote -> addVote (:2161)."""

@@ -213,6 +213,11 @@ class NodeMetrics:
                                    "Total transactions committed")
         self.block_size = r.gauge("consensus", "block_size_bytes",
                                   "Size of the latest block")
+        self.invalid_votes = r.counter(
+            "consensus", "invalid_votes_total",
+            "Votes dropped by the cheap pre-WAL admission filter "
+            "(unknown validator, address mismatch, wrong height) — "
+            "the garbage-flood shield")
         self.step_duration = r.histogram(
             "consensus", "step_duration_seconds",
             "Wall time spent in each consensus step (labeled by the "
@@ -288,9 +293,33 @@ class NodeMetrics:
             "verifyplane", "flush_host_fallbacks_recent",
             "Flushes in the ledger window that degraded to the host "
             "path (dispatch failpoint or in-flight device fault)")
+        # QoS lanes (overload resilience): per-lane verified rows, shed
+        # submissions (BULK only — CONSENSUS is never shed), and the
+        # per-lane pending depth sampled at scrape time
+        self.plane_lane_rows = r.counter(
+            "verifyplane", "lane_rows_total",
+            "Signature rows verified per QoS lane "
+            "(lane=consensus|bulk)")
+        self.plane_shed = r.counter(
+            "verifyplane", "shed_total",
+            "Submissions shed with an explicit Overloaded verdict, "
+            "labeled by lane (bulk deadline/queue-bound sheds; "
+            "consensus stays 0 by construction)")
+        self.plane_lane_depth = r.gauge(
+            "verifyplane", "lane_queue_depth",
+            "Pending signature rows per QoS lane at scrape time")
         # mempool
         self.mempool_size = r.gauge("mempool", "size",
                                     "Pending transactions")
+        self.mempool_admission = r.counter(
+            "mempool", "admission_total",
+            "CheckTx admission-control outcomes "
+            "(outcome=admitted|rejected_inflight|rejected_watermark"
+            "|rejected_breaker)")
+        self.mempool_overloaded = r.counter(
+            "mempool", "overloaded_total",
+            "CheckTx requests answered with the explicit OVERLOADED "
+            "code (admission fast-reject or BULK-lane shed)")
         # p2p
         self.peers = r.gauge("p2p", "peers", "Connected peers")
         # blocksync
@@ -376,6 +405,13 @@ class NodeMetrics:
             vp = sys.modules.get("cometbft_tpu.verifyplane.plane")
             plane = vp and (vp._GLOBAL or vp._LAST)
             if plane is not None:
+                for lane, d in plane.lane_depths().items():
+                    self.plane_lane_depth.set(float(d), lane=lane)
+                # sheds are NOT sampled here: _shed_count inc's the
+                # owning plane's registry live, and overwriting from
+                # the process-global plane would regress the counter
+                # (50 -> 0) whenever this node's plane isn't the global
+                # one (LocalNetwork: several planes, one process)
                 s = plane.ledger.summary()
                 self.plane_flush_ledger_size.set(float(s["flushes"]))
                 if s["flushes"]:

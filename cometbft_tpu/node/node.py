@@ -41,6 +41,7 @@ class Node(BaseService):
         statesync_discovery: float = 45.0,
         app_state_bytes: bytes = b"",
         verify_plane=None,
+        mempool_config=None,
     ):
         """statesync_light_client: a light.Client already trusting a root
         header; providing it turns on the statesync->blocksync->consensus
@@ -142,7 +143,27 @@ class Node(BaseService):
                 ))
                 self.app.commit()
 
-        self.mempool = Mempool(self.app_conns.mempool)
+        # mempool + CheckTx admission control (config [mempool]): the
+        # admission gate reads the pool's fill fraction (watermarks)
+        # and the device breaker state (tightened host-fallback bound)
+        from cometbft_tpu.config.config import MempoolConfig
+
+        mcfg = mempool_config or MempoolConfig()
+
+        def _breaker_open():
+            from cometbft_tpu.crypto import batch as cbatch
+
+            return cbatch.device_breaker().state == "open"
+
+        self.mempool = Mempool(
+            self.app_conns.mempool, max_txs=mcfg.size,
+            cache_size=mcfg.cache_size, recheck=mcfg.recheck,
+            verify_sigs=mcfg.verify_sigs,
+        )
+        self.mempool.admission = mcfg.build_admission(
+            fill_fn=self.mempool.fill_fraction,
+            breaker_open_fn=_breaker_open,
+        )
         # evidence pool backed by the state store's validator history
         # (node/node.go:369 createEvidenceReactor)
         from cometbft_tpu.evidence.pool import EvidencePool
@@ -157,6 +178,7 @@ class Node(BaseService):
         from cometbft_tpu.types.event_bus import EventBus
 
         self.metrics = NodeMetrics()
+        self.mempool.metrics = self.metrics
         self.event_bus = EventBus()
         # verify plane (config [verify_plane]; cometbft_tpu.verifyplane):
         # accepts a VerifyPlaneConfig, a ready VerifyPlane, or None.

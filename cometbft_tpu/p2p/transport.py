@@ -14,7 +14,12 @@ from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
 from cometbft_tpu.p2p.key import NetAddress, NodeInfo, NodeKey
 
-HANDSHAKE_TIMEOUT = 10.0
+# Generous by design: the secret-connection handshake runs pure-Python
+# X25519/ed25519 on this image (no `cryptography` wheel), and CI hosts
+# run the whole multi-node suite on one core — a loaded host can spend
+# several seconds per handshake. 10 s flaked under parallel host load;
+# the timeout only bounds genuinely dead peers, so erring long is free.
+HANDSHAKE_TIMEOUT = 30.0
 
 fp.register("p2p.handshake",
             "secret-conn established, NodeInfo not yet exchanged "

@@ -355,20 +355,37 @@ class Routes:
             return bytes.fromhex(tx[2:])
         return base64.b64decode(tx)
 
+    @staticmethod
+    def _with_retry_hint(out: dict, resp) -> dict:
+        """Surface the node's explicit overload verdict as a
+        machine-readable Retry-After analog: OVERLOADED CheckTx
+        responses carry the structured ResponseCheckTx.retry_after_ms
+        (the log repeats it for humans); lift it into the JSON-RPC
+        response so clients back off without parsing log strings."""
+        from cometbft_tpu.abci import types as abci
+
+        if resp.code == abci.CODE_TYPE_OVERLOADED:
+            out["retry_after_ms"] = (
+                getattr(resp, "retry_after_ms", 0.0) or 1000.0)
+        return out
+
     def broadcast_tx_sync(self, tx):
         raw = self._decode_tx(tx)
         resp = self.node.broadcast_tx(raw)
-        return {"code": resp.code, "data": "", "log": resp.log,
-                "hash": hashlib.sha256(raw).hexdigest().upper()}
+        return self._with_retry_hint(
+            {"code": resp.code, "data": "", "log": resp.log,
+             "hash": hashlib.sha256(raw).hexdigest().upper()}, resp)
 
     def broadcast_tx_async(self, tx):
         """Returns without waiting for a CheckTx RESULT, but the submit
         itself runs on this thread — a node that refuses txs outright
-        (read-only inspect server) must not hand back phantom success."""
+        (read-only inspect server, admission fast-reject) must not hand
+        back phantom success."""
         raw = self._decode_tx(tx)
-        self.node.broadcast_tx(raw)
-        return {"code": 0, "data": "", "log": "",
-                "hash": hashlib.sha256(raw).hexdigest().upper()}
+        resp = self.node.broadcast_tx(raw)
+        return self._with_retry_hint(
+            {"code": resp.code, "data": "", "log": resp.log,
+             "hash": hashlib.sha256(raw).hexdigest().upper()}, resp)
 
     def broadcast_tx_commit(self, tx, timeout: float = 30.0):
         """CheckTx, then wait for the tx's DeliverTx event
@@ -382,8 +399,10 @@ class Routes:
         try:
             check = self.node.broadcast_tx(raw)
             if check.code != 0:
-                return {"check_tx": {"code": check.code, "log": check.log},
-                        "deliver_tx": {}, "hash": txhash, "height": 0}
+                return self._with_retry_hint(
+                    {"check_tx": {"code": check.code, "log": check.log},
+                     "deliver_tx": {}, "hash": txhash, "height": 0},
+                    check)
             msg = sub.next(timeout=timeout)
             if msg is None:
                 raise RPCError(-32603, "timed out waiting for tx commit")

@@ -64,6 +64,10 @@ class Simnet:
         self.net = SimNetwork(n_nodes, seed, basedir, **kw)
         self.schedule: List[Dict] = []
         self._started = False
+        # every flood-op CheckTx response, in injection order: the soak
+        # scenarios assert overload verdicts are EXPLICIT (code +
+        # retry hint), never silent drops
+        self.flood_results: List[Dict] = []
         # flush-ledger position at sim start: failure blobs attach the
         # ledger tail only if it advanced during THIS simulation
         from cometbft_tpu import verifyplane
@@ -95,7 +99,16 @@ class Simnet:
                          f"op:{op['op']}")
         if until is None and until_height is not None:
             target = until_height
-            until = lambda: all(  # noqa: E731
+            # an open-loop flood is SUSTAINED traffic: reaching the
+            # target height mid-window must not end the run, or the
+            # soak would assert overload behavior against a flood that
+            # never fully fired
+            horizon = max(
+                (float(o["at"]) + float(o.get("duration", 0.0))
+                 for o in self.schedule if o["op"] == "flood"),
+                default=0.0,
+            )
+            until = lambda: net.now >= horizon and all(  # noqa: E731
                 n.height() >= target for n in net.nodes if n.alive
             ) and any(n.alive for n in net.nodes)
         return net.run_until(until, max_time=net.now + max_time)
@@ -164,6 +177,54 @@ class Simnet:
             node = net.nodes[op["node"]]
             if node.alive:
                 node.node.mempool.check_tx(bytes.fromhex(op["data"]))
+        elif kind == "flood":
+            self._launch_flood(op)
+
+    # flood txs are signed with ONE deterministic throwaway key (a
+    # function of nothing but this constant), so the same (seed,
+    # schedule) floods byte-identical txs
+    _FLOOD_KEY_SEED = b"simnet-flood-key" + b"\x00" * 16
+
+    def _launch_flood(self, op: Dict) -> None:
+        """Open-loop tx stream: rate*duration injections at FIXED sim
+        times (injection never waits on a response — the open-loop
+        discipline of test/loadtime), through the target node's full
+        broadcast_tx path (admission control + sigtx verify via the
+        BULK lane when signed + ABCI CheckTx)."""
+        net = self.net
+        idx = int(op["node"])
+        rate = float(op["rate"])
+        count = int(round(rate * float(op["duration"])))
+        size = int(op.get("size", 16))
+        signed = bool(op.get("signed", False))
+        priv = sigtx = None
+        if signed:
+            from cometbft_tpu.crypto.keys import PrivKey
+            from cometbft_tpu.mempool import sigtx
+
+            priv = PrivKey.generate(self._FLOOD_KEY_SEED)
+        base = len(self.flood_results)
+
+        def inject(k: int, tx: bytes) -> None:
+            node = net.nodes[idx]
+            if not node.alive:
+                self.flood_results.append(
+                    {"seq": base + k, "at": net.now, "code": None,
+                     "log": "target dead"})
+                return
+            with net._node_scope(node):
+                resp = node.node.broadcast_tx(tx)
+            self.flood_results.append(
+                {"seq": base + k, "at": net.now, "code": resp.code,
+                 "log": resp.log})
+            net._pump(node)
+
+        for k in range(count):
+            payload = (b"flood-%d-%d=" % (idx, base + k)).ljust(
+                size, b"x")
+            tx = sigtx.wrap(priv, payload) if signed else payload
+            net.schedule(k / rate, lambda k=k, tx=tx: inject(k, tx),
+                         f"flood n{idx}")
 
     def _launch_light_attack(self, op: Dict) -> None:
         net = self.net

@@ -32,6 +32,15 @@ Op catalog (each op is a plain dict, `at` in simulated seconds):
       byz validators at height h) to node i as evidence gossip.
   {"at": t, "op": "tx", "node": i, "data": "<hex>"}
       Inject a transaction into node i's mempool.
+  {"at": t, "op": "flood", "node": i, "rate": txs_per_sim_second,
+   "duration": s, "signed": bool, "size": payload_bytes}
+      Open-loop sustained tx stream into node i's broadcast_tx path:
+      rate*duration txs injected at FIXED simulated times (open-loop —
+      injection never waits on responses, like test/loadtime). With
+      "signed": true each tx rides a sigtx envelope (deterministic key)
+      so CheckTx signature verification exercises the verify plane's
+      BULK lane. Every CheckTx response is recorded on the harness
+      (Simnet.flood_results) so overload verdicts are assertable.
 """
 from __future__ import annotations
 
@@ -39,7 +48,7 @@ import json
 from typing import Dict, List
 
 OPS = ("partition", "heal", "link", "kill", "restart", "failpoint",
-       "equivocate", "garbage", "light_attack", "tx")
+       "equivocate", "garbage", "light_attack", "tx", "flood")
 
 _LINK_KEYS = ("drop", "delay", "jitter", "dup", "reorder")
 
@@ -73,6 +82,15 @@ def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
                     raise ScheduleError(
                         f"{key} node out of range in {op!r}"
                     )
+        # node-targeting ops must NAME their target up front: a missing
+        # selector otherwise validates fine and KeyErrors mid-simulation
+        # (a replay-blob failure instead of this loud ScheduleError)
+        if kind in ("kill", "restart", "failpoint", "equivocate",
+                    "garbage", "tx", "flood") and "node" not in op:
+            raise ScheduleError(f"{kind} requires a node in {op!r}")
+        if kind == "light_attack" and "target" not in op:
+            raise ScheduleError(
+                f"light_attack requires a target in {op!r}")
         if kind == "partition":
             seen = set()
             for grp in op.get("groups", []):
@@ -84,6 +102,14 @@ def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
             from cometbft_tpu.libs.failpoints import parse_spec
 
             parse_spec(op.get("spec", ""))  # raises on malformed specs
+        if kind == "flood":
+            if float(op.get("rate", 0)) <= 0:
+                raise ScheduleError(f"flood rate must be > 0 in {op!r}")
+            if float(op.get("duration", 0)) <= 0:
+                raise ScheduleError(
+                    f"flood duration must be > 0 in {op!r}")
+            if int(op.get("size", 16)) < 1:
+                raise ScheduleError(f"flood size must be >= 1 in {op!r}")
 
 
 def schedule_to_json(seed: int, schedule: List[Dict]) -> str:

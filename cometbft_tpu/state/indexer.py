@@ -259,31 +259,40 @@ class IndexerService:
     def _run(self) -> None:
         counters = {}
         while not self._stop.is_set():
-            msg = self._sub_tx.next(timeout=0.1)
-            while msg is not None:
-                d = msg.data
-                h = d["height"]
-                idx = counters.get(h, 0)
-                counters[h] = idx + 1
-                self.tx_indexer.index(h, idx, d["tx"], d["result"])
-                for s in self.extra_sinks:
-                    try:
-                        s.index_tx_events(h, idx, d["tx"], d["result"])
-                    except Exception:  # noqa: BLE001 - sink is aux
-                        pass
-                msg = self._sub_tx.next(timeout=0)
+            try:
+                self._drain(counters)
+            except sqlite3.ProgrammingError:
+                # the backing DB was closed under us mid-drain (node
+                # shutdown racing a deep commit backlog, e.g. after a
+                # sustained tx flood) — nothing further can be indexed
+                return
+
+    def _drain(self, counters: dict) -> None:
+        msg = self._sub_tx.next(timeout=0.1)
+        while msg is not None:
+            d = msg.data
+            h = d["height"]
+            idx = counters.get(h, 0)
+            counters[h] = idx + 1
+            self.tx_indexer.index(h, idx, d["tx"], d["result"])
+            for s in self.extra_sinks:
+                try:
+                    s.index_tx_events(h, idx, d["tx"], d["result"])
+                except Exception:  # noqa: BLE001 - sink is aux
+                    pass
+            msg = self._sub_tx.next(timeout=0)
+        msg = self._sub_blk.next(timeout=0)
+        while msg is not None:
+            blk = msg.data["block"]
+            tags = {"block.proposer":
+                    [blk.header.proposer_address.hex().upper()]}
+            self.block_indexer.index(blk.header.height, tags)
+            for s in self.extra_sinks:
+                try:
+                    s.index_block_events(blk.header.height, tags)
+                except Exception:  # noqa: BLE001 - sink is aux
+                    pass
             msg = self._sub_blk.next(timeout=0)
-            while msg is not None:
-                blk = msg.data["block"]
-                tags = {"block.proposer":
-                        [blk.header.proposer_address.hex().upper()]}
-                self.block_indexer.index(blk.header.height, tags)
-                for s in self.extra_sinks:
-                    try:
-                        s.index_block_events(blk.header.height, tags)
-                    except Exception:  # noqa: BLE001 - sink is aux
-                        pass
-                msg = self._sub_blk.next(timeout=0)
 
     def stop(self) -> None:
         self._stop.set()

@@ -7,8 +7,12 @@ bucket batches, flushes on a micro-batch deadline or a full bucket, and
 fuses per-group voting-power tallies into the same pass.
 """
 from cometbft_tpu.verifyplane.plane import (
+    LANE_BULK,
+    LANE_CONSENSUS,
+    LANES,
     FlushLedger,
     PlaneError,
+    PlaneOverloaded,
     PlaneQueueFull,
     PlaneStopped,
     QuorumGroup,
@@ -25,8 +29,12 @@ from cometbft_tpu.verifyplane.plane import (
 )
 
 __all__ = [
+    "LANE_BULK",
+    "LANE_CONSENSUS",
+    "LANES",
     "FlushLedger",
     "PlaneError",
+    "PlaneOverloaded",
     "PlaneQueueFull",
     "PlaneStopped",
     "QuorumGroup",

@@ -1,0 +1,361 @@
+"""Concurrent CheckTx hammer: the mempool's overload seams under real
+thread contention (ISSUE 7 satellite).
+
+Every scenario here is a race that a single-threaded test cannot see:
+cache TOCTOU on duplicate submissions, full-pool drop/un-cache
+semantics under interleaved update() commits, plane-routed sigtx
+verification racing the dispatcher, and BULK-lane sheds surfacing as
+explicit non-OK codes while honest txs keep flowing.
+"""
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.keys import PrivKey
+from cometbft_tpu.mempool import sigtx
+from cometbft_tpu.mempool.admission import AdmissionController
+from cometbft_tpu.mempool.mempool import Mempool
+from cometbft_tpu.verifyplane import (
+    VerifyPlane,
+    set_global_plane,
+)
+
+N_THREADS = 8
+
+
+def _hammer(fn, n_threads=N_THREADS):
+    """Run fn(thread_index) on n_threads, re-raising any failure."""
+    errs = []
+
+    def run(k):
+        try:
+            fn(k)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(k,))
+          for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs[:3]
+
+
+@pytest.fixture
+def host_plane():
+    """A running host-path plane registered as the process global —
+    the mempool routes sigtx checks through its BULK lane."""
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    yield plane
+    set_global_plane(None)
+    plane.stop()
+
+
+def test_concurrent_duplicate_tx_admitted_once():
+    """Cache TOCTOU: N threads racing the SAME tx — exactly one body
+    runs CheckTx through to the pool, the rest dedup; the pool and the
+    gas table hold exactly one entry."""
+    mp = Mempool(KVStoreApplication(), max_txs=64, verify_sigs=False)
+    codes = []
+    lock = threading.Lock()
+
+    def submit(_k):
+        for _ in range(50):
+            resp = mp.check_tx(b"dup-tx=1")
+            with lock:
+                codes.append(resp.code)
+
+    _hammer(submit)
+    assert codes.count(abci.CODE_TYPE_OK) == 1, (
+        "duplicate tx admitted more than once (cache TOCTOU)"
+    )
+    assert mp.size() == 1
+    assert mp.gas_entries() == 1
+
+
+def test_concurrent_full_pool_drop_and_uncache():
+    """Full-queue semantics under contention: overflow txs get an
+    explicit 'mempool is full', leave the cache (resubmittable), never
+    leak gas entries — and after update() frees space, a previously
+    dropped tx IS re-admittable."""
+    cap = 16
+    mp = Mempool(KVStoreApplication(), max_txs=cap, verify_sigs=False)
+    results = {}
+    lock = threading.Lock()
+
+    def submit(k):
+        for i in range(cap):
+            tx = b"tx-%d-%d=v" % (k, i)
+            resp = mp.check_tx(tx)
+            with lock:
+                results[tx] = resp
+
+    _hammer(submit)
+    oks = [tx for tx, r in results.items()
+           if r.code == abci.CODE_TYPE_OK]
+    fulls = [tx for tx, r in results.items()
+             if r.code != abci.CODE_TYPE_OK]
+    assert len(oks) == cap
+    assert fulls and all("full" in results[tx].log for tx in fulls)
+    assert mp.size() == cap
+    assert mp.gas_entries() == cap, "gas table leaked dropped txs"
+    # commit everything; a full-dropped tx must now be re-admittable
+    # (the drop un-cached it — dedup must not swallow the retry)
+    mp.update(1, oks)
+    assert mp.size() == 0 and mp.gas_entries() == 0
+    retry = fulls[0]
+    assert mp.check_tx(retry).code == abci.CODE_TYPE_OK
+    assert mp.size() == 1 and mp.gas_entries() == 1
+
+
+def test_concurrent_checktx_races_update_no_gas_leak():
+    """The hygiene invariant under the nastiest interleaving: CheckTx
+    admissions racing update() commits/rechecks must end with _tx_gas
+    tracking the pool EXACTLY (any excess is the leak the ISSUE
+    names)."""
+    mp = Mempool(KVStoreApplication(), max_txs=128, verify_sigs=False)
+    stop = threading.Event()
+
+    def committer():
+        h = 0
+        while not stop.is_set():
+            h += 1
+            mp.update(h, mp.reap(max_txs=16))
+
+    ct = threading.Thread(target=committer)
+    ct.start()
+    try:
+        _hammer(lambda k: [mp.check_tx(b"race-%d-%d=v" % (k, i))
+                           for i in range(200)])
+    finally:
+        stop.set()
+        ct.join()
+    mp.update(9999, mp.reap(max_txs=-1))
+    assert mp.size() == 0
+    assert mp.gas_entries() == 0, "gas entries leaked across update()"
+
+
+def test_plane_routed_verify_matches_host_oracle(host_plane):
+    """Correctness under concurrency: valid/corrupted/malformed sigtx
+    envelopes and unsigned txs hammered through the BULK lane must land
+    exactly where the host oracle says — no cross-contamination between
+    interleaved verdicts."""
+    mp = Mempool(KVStoreApplication(), max_txs=4096, verify_sigs=True)
+    privs = [PrivKey.generate(bytes([40 + k]) * 32)
+             for k in range(N_THREADS)]
+    expected = {}  # tx -> expected CheckTx code
+    per_thread = []
+    for k in range(N_THREADS):
+        txs = []
+        for i in range(25):
+            payload = b"oracle-%d-%d=v" % (k, i)
+            kind = i % 4
+            if kind == 0:  # valid envelope
+                tx = sigtx.wrap(privs[k], payload)
+                code = abci.CODE_TYPE_OK
+            elif kind == 1:  # corrupted signature
+                good = bytearray(sigtx.wrap(privs[k], payload))
+                good[len(sigtx.MAGIC) + sigtx.PUB_LEN] ^= 0xFF
+                tx, code = bytes(good), abci.CODE_TYPE_BAD_SIGNATURE
+            elif kind == 2:  # magic present, frame too short
+                tx = sigtx.MAGIC + payload
+                code = abci.CODE_TYPE_BAD_SIGNATURE
+            else:  # unsigned: app-level auth applies, kvstore accepts
+                tx, code = payload, abci.CODE_TYPE_OK
+            txs.append(tx)
+            expected[tx] = code
+        per_thread.append(txs)
+    got = {}
+    lock = threading.Lock()
+
+    def submit(k):
+        for tx in per_thread[k]:
+            resp = mp.check_tx(tx)
+            with lock:
+                got[tx] = resp.code
+
+    _hammer(submit)
+    mismatches = {tx: (got[tx], code) for tx, code in expected.items()
+                  if got[tx] != code}
+    assert not mismatches, f"{len(mismatches)} verdicts diverged " \
+                           f"from the host oracle: " \
+                           f"{list(mismatches.items())[:3]}"
+    n_ok = sum(1 for c in expected.values()
+               if c == abci.CODE_TYPE_OK)
+    assert mp.size() == n_ok
+    assert mp.gas_entries() == n_ok
+    # the signed txs really rode the BULK lane of the shared plane
+    assert host_plane.stats()["lane_rows"]["bulk"] > 0
+
+
+def test_bulk_shed_surfaces_as_overloaded_code():
+    """Sheds are EXPLICIT: a bulk lane squeezed to 1 row with a long
+    coalescing window must reject overflow submissions with
+    CODE_TYPE_OVERLOADED + a retry hint (never a silent drop or a
+    false OK), and a shed tx must stay resubmittable."""
+    # deadline > window: the tx that DID win the 1-row queue flushes
+    # before it can age out (this test isolates queue-bound sheds; the
+    # deadline-shed path gets its own test below)
+    plane = VerifyPlane(window_ms=60.0, use_device=False,
+                        bulk_window_ms=60.0, bulk_max_queue=1,
+                        bulk_deadline_ms=500.0)
+    plane.start()
+    set_global_plane(plane)
+    mp = Mempool(KVStoreApplication(), max_txs=4096, verify_sigs=True)
+    priv = PrivKey.generate(b"\x51" * 32)
+    txs = [sigtx.wrap(priv, b"shed-%d-%d=v" % (k, i))
+           for k in range(N_THREADS) for i in range(20)]
+    responses = {}
+    lock = threading.Lock()
+    try:
+        def submit(k):
+            for tx in txs[k::N_THREADS]:
+                resp = mp.check_tx(tx)
+                with lock:
+                    responses[tx] = resp
+
+        _hammer(submit)
+        shed = [r for r in responses.values()
+                if r.code == abci.CODE_TYPE_OVERLOADED]
+        ok = [r for r in responses.values()
+              if r.code == abci.CODE_TYPE_OK]
+        assert len(shed) + len(ok) == len(txs), \
+            f"unexpected codes: {set(r.code for r in responses.values())}"
+        assert shed, "squeezed bulk lane never shed"
+        assert ok, "every tx shed — lane never drained"
+        for r in shed:
+            assert "retry_after_ms=" in r.log, r
+        stats = plane.stats()
+        assert stats["sheds"]["bulk"] >= len(shed)
+        assert stats["sheds"]["consensus"] == 0
+        # a shed tx was un-cached: resubmitting it alone (no contention)
+        # must verify and land
+        shed_tx = next(tx for tx, r in responses.items()
+                       if r.code == abci.CODE_TYPE_OVERLOADED)
+        retry = mp.check_tx(shed_tx)
+        assert retry.code == abci.CODE_TYPE_OK, retry
+    finally:
+        set_global_plane(None)
+        plane.stop()
+
+
+def test_deadline_shed_surfaces_as_overloaded_code():
+    """The OTHER shed path: submissions that ENTER the bulk queue but
+    age past bulk_deadline_ms are failed by the DISPATCHER via the
+    future (not the submit-time raise) — VerifyFuture.result() must
+    preserve the PlaneOverloaded type so the mempool answers OVERLOADED
+    instead of silently host-verifying the shed tx."""
+    plane = VerifyPlane(window_ms=0.5, use_device=False,
+                        bulk_window_ms=150.0, bulk_max_queue=100_000,
+                        bulk_deadline_ms=5.0)
+    plane.start()
+    set_global_plane(plane)
+    mp = Mempool(KVStoreApplication(), max_txs=4096, verify_sigs=True)
+    priv = PrivKey.generate(b"\x52" * 32)
+    responses = []
+    lock = threading.Lock()
+    try:
+        def submit(k):
+            mine = [mp.check_tx(sigtx.wrap(priv, b"dl-%d-%d=v" % (k, i)))
+                    for i in range(6)]
+            with lock:
+                responses.extend(mine)
+
+        _hammer(submit)
+        codes = {r.code for r in responses}
+        assert codes <= {abci.CODE_TYPE_OK, abci.CODE_TYPE_OVERLOADED}, \
+            codes
+        shed = [r for r in responses
+                if r.code == abci.CODE_TYPE_OVERLOADED]
+        assert shed, "nothing aged past the 5ms bulk deadline"
+        for r in shed:
+            assert "retry_after_ms=" in r.log, r
+        assert plane.stats()["sheds"]["bulk"] >= len(shed)
+    finally:
+        set_global_plane(None)
+        plane.stop()
+
+
+def test_admission_inflight_bound_under_hammer():
+    """The admission gate keeps its inflight invariant under a thread
+    storm: concurrent admitted CheckTx never exceeds the bound, every
+    rejection is an explicit OVERLOADED with the hint, and the gate
+    fully releases afterward."""
+    seen_max = [0]
+    lock = threading.Lock()
+
+    class SlowApp(KVStoreApplication):
+        def __init__(self, adm):
+            super().__init__()
+            self._adm = adm
+
+        def check_tx(self, req):
+            with lock:
+                seen_max[0] = max(seen_max[0], self._adm.inflight)
+            return super().check_tx(req)
+
+    adm = AdmissionController(max_inflight=4, retry_after_ms=123.0)
+    mp = Mempool(SlowApp(adm), max_txs=4096, verify_sigs=False,
+                 admission=adm)
+    adm._fill_fn = mp.fill_fraction
+    responses = []
+
+    def submit(k):
+        mine = []
+        for i in range(100):
+            mine.append(mp.check_tx(b"adm-%d-%d=v" % (k, i)))
+        with lock:
+            responses.extend(mine)
+
+    _hammer(submit)
+    assert seen_max[0] <= 4, "inflight bound violated under contention"
+    rejected = [r for r in responses
+                if r.code == abci.CODE_TYPE_OVERLOADED]
+    for r in rejected:
+        assert "retry_after_ms=123.0" in r.log, r
+    st = adm.stats()
+    assert st["inflight"] == 0, "admission slots leaked"
+    assert st["counts"]["admitted"] == len(responses) - len(rejected)
+
+
+def test_update_recheck_drops_invalidated_txs():
+    """Recheck semantics (clist_mempool.go:577): a tx the new state
+    invalidates is dropped by update(), leaves the cache (resubmittable
+    once valid again) and the gas table; with the config flag off the
+    pool keeps it."""
+
+    class FlagApp(KVStoreApplication):
+        def __init__(self):
+            super().__init__()
+            self.reject = set()
+
+        def check_tx(self, req):
+            if req.tx in self.reject:
+                return abci.ResponseCheckTx(code=9, log="stale")
+            return super().check_tx(req)
+
+    for flag in (True, False):
+        app = FlagApp()
+        mp = Mempool(app, max_txs=64, verify_sigs=False, recheck=flag)
+        txs = [b"rc-%d=v" % i for i in range(8)]
+        for tx in txs:
+            assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        # the block invalidates the odd txs and commits the first two
+        app.reject = set(txs[3::2])
+        mp.update(1, txs[:2])
+        survivors = set(mp.reap())
+        if flag:
+            assert survivors == set(txs[2:]) - app.reject
+            # dropped txs re-admit once valid again (cache hygiene)
+            app.reject = set()
+            stale = txs[3]
+            assert mp.check_tx(stale).code == abci.CODE_TYPE_OK
+        else:
+            assert survivors == set(txs[2:]), \
+                "recheck=False must keep survivors untouched"
+        assert mp.gas_entries() == mp.size(), "gas/pool divergence"

@@ -131,6 +131,8 @@ def test_kernel_matches_oracle():
     assert not exp[3] and not exp[17] and exp[0]
 
 
+@pytest.mark.slow  # ~71 s on the 1-core host under suite load;
+# ristretto_rejects_noncanonical + mixed_batch_dispatch stay quick
 def test_kernel_rejects_bad_encodings():
     from cometbft_tpu.ops import sr25519_kernel as srk
 

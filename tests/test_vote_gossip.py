@@ -166,6 +166,21 @@ def test_vote_set_bits_fills_peer_bitmap():
     assert peer.votes_sent() == []
 
 
+def _wait_mesh(nodes, want_peers, timeout=90.0):
+    """Deflake (host-load resilience): dials are ephemeral-port TCP
+    with pure-Python handshakes — under parallel host load a dial can
+    time out. node.dial registers the peer as persistent, so the
+    switch's redial loop retries with backoff; this just waits
+    (generously) until every node sees the full mesh before the test
+    starts expecting consensus progress."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(n.switch.num_peers() >= want_peers for n in nodes):
+            return True
+        time.sleep(0.25)
+    return False
+
+
 def test_tcp_net_converges_with_bounded_duplicates(tmp_path):
     """5 validators over real TCP reach height 4; lack-based gossip
     keeps duplicate vote deliveries far below flood levels (flooding a
@@ -178,15 +193,19 @@ def test_tcp_net_converges_with_bounded_duplicates(tmp_path):
         n = Node(KVStoreApplication(), state.copy(), privval=FilePV(priv),
                  home=str(tmp_path / f"n{i}"), timeouts=FAST, p2p=True,
                  node_key=NodeKey(PrivKey.generate(bytes([0x50 + i]) * 32)))
-        addrs.append(n.listen())
+        addrs.append(n.listen())  # port=0: ephemeral, no reuse races
         nodes.append(n)
     for n in nodes:
         n.start()
     try:
+        # bounded retries: a failed first dial is retried by the
+        # persistent-peer redial loop; only the mesh-up wait is bounded
         for i, n in enumerate(nodes):
             for j, a in enumerate(addrs):
                 if i != j:
                     n.dial(a)
+        assert _wait_mesh(nodes, want_peers=len(nodes) - 1), \
+            f"mesh never formed: {[n.switch.num_peers() for n in nodes]}"
         for n in nodes:
             assert n.consensus.wait_for_height(4, timeout=120), \
                 f"stuck at {n.height()}"
