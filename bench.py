@@ -101,7 +101,7 @@ class CompileWatch:
 
 # units where a LARGER value is better; everything else (ms) is
 # smaller-is-better
-BETTER_HIGHER_UNITS = ("sigs/sec", "tx/s", "x")
+BETTER_HIGHER_UNITS = ("sigs/sec", "tx/s", "headers/sec", "x")
 BASELINE_THRESHOLD_PCT = 30.0  # tunnel noise floor; see WALL_RUNS note
 
 
@@ -1011,6 +1011,192 @@ def cfg9_sustained(rate=120.0, duration=45.0, n_nodes=4):
     }
 
 
+def _make_light_chain(n_heights, n_vals, seed=9100):
+    """Deterministic ed25519 light-block chain (stable valset) for the
+    gateway benches: {height: LightBlock} + the Provider over it."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.light import client as lc
+    from cometbft_tpu.light import verifier as lv
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import Header
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.commit import (
+        BLOCK_ID_FLAG_COMMIT,
+        Commit,
+        CommitSig,
+    )
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    T0 = 1_700_000_000
+    privs = [
+        PrivKey.generate((seed + i).to_bytes(4, "big") + b"\x55" * 28)
+        for i in range(n_vals)
+    ]
+    vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    blocks = {}
+    prev_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        header = Header(
+            chain_id=CHAIN_ID, height=h, time=Timestamp(T0 + h, 0),
+            last_block_id=prev_bid, validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            proposer_address=vs.validators[0].address,
+            app_hash=b"\x01" * 32,
+        )
+        bid = BlockID(header.hash(), PartSetHeader(1, header.hash()))
+        sigs = []
+        for v in vs.validators:
+            ts = Timestamp(T0 + h, 42)
+            sb = canonical.canonical_vote_bytes(
+                CHAIN_ID, canonical.PRECOMMIT_TYPE, h, 0, bid, ts
+            )
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                                  by_addr[v.address].sign(sb)))
+        blocks[h] = lv.LightBlock(
+            lv.SignedHeader(header, Commit(h, 0, bid, sigs)), vs
+        )
+        prev_bid = bid
+    provider = lc.Provider(CHAIN_ID, lambda h: blocks.get(h))
+    return blocks, provider, (T0 + n_heights + 100)
+
+
+def _gateway_run(blocks, provider, now_s, n_clients, targets_of,
+                 use_gateway, ledger_cap=8192):
+    """Drive n_clients worth of light-client syncs, with or without
+    the gateway, against a FRESH host-path verify plane — and read the
+    plane's flush ledger for the submission count (the acceptance
+    metric: coalescing must be visible in ledger rows, not inferred).
+
+    use_gateway=False is the uncoalesced baseline: every client owns a
+    private light.Client + store (what N independent light clients do
+    today). use_gateway=True routes everyone through ONE LightGateway
+    (coalescer + shared store + LRU)."""
+    import threading
+
+    from cometbft_tpu.light import client as lc
+    from cometbft_tpu.lightgate import LightGateway, gateway_batch_fn
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+    from cometbft_tpu.verifyplane.plane import FlushLedger
+
+    now = Timestamp(now_s, 0)
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.ledger = FlushLedger(capacity=ledger_cap)
+    plane.start()
+    set_global_plane(plane)
+    gw = None
+    if use_gateway:
+        gw = LightGateway(CHAIN_ID, provider, cache_size=1024)
+        gw.client.trust_light_block(blocks[1])
+        gw.start(register=False)
+    lats, errs = [], []
+    lock = threading.Lock()
+
+    def worker(k):
+        mine = []
+        try:
+            if use_gateway:
+                for t in targets_of(k):
+                    t0 = _now_ms()
+                    v = gw.verify(1, t, now=now)
+                    mine.append(_now_ms() - t0)
+                    assert v["status"] == "verified"
+            else:
+                c = lc.Client(CHAIN_ID, provider, trusting_period=1e6,
+                              batch_fn=gateway_batch_fn())
+                c.trust_light_block(blocks[1])
+                for t in targets_of(k):
+                    t0 = _now_ms()
+                    c.verify_light_block_at_height(t, now=now)
+                    mine.append(_now_ms() - t0)
+        except Exception as e:  # noqa: BLE001 - recorded below
+            with lock:
+                errs.append(repr(e))
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_clients)]
+    t0 = _now_ms()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = _now_ms() - t0
+    set_global_plane(None)
+    plane.stop()
+    assert not errs, errs[:3]
+    recs = plane.dump_flushes()["flushes"]
+    subs = sum(r["subs"] for r in recs)
+    g_rows = sum(r["g_rows"] for r in recs)
+    out = {"wall_ms": wall, "lats": lats, "plane_subs": subs,
+           "gateway_rows": g_rows, "flushes": len(recs)}
+    if gw is not None:
+        out["gw_stats"] = gw.stats()
+    return out
+
+
+def cfg10_gateway(n_clients=32, n_heights=48, n_vals=8):
+    """#10: light-client gateway — N concurrent clients, coalesced
+    skipping verification (ROADMAP item 3; ISSUE 8 acceptance).
+
+    Each client syncs a mix of SHARED targets (the popular heights a
+    wallet fleet all jumps to) and a personal one (disjoint spread).
+    The uncoalesced baseline is N private light clients doing the same
+    work — today's serving story. The acceptance bar: with the gateway,
+    verify-plane submissions (counted from the always-on flush ledger,
+    not inferred) must be <= 0.5x the uncoalesced count."""
+    blocks, provider, now_s = _make_light_chain(n_heights, n_vals)
+    shared = [n_heights // 3, 2 * n_heights // 3, n_heights]
+
+    def targets_of(k):
+        return sorted(set(shared + [2 + (k % 8)]))
+
+    base = _gateway_run(blocks, provider, now_s, n_clients, targets_of,
+                        use_gateway=False)
+    gwr = _gateway_run(blocks, provider, now_s, n_clients, targets_of,
+                       use_gateway=True)
+    n_requests = len(gwr["lats"])
+    assert gwr["plane_subs"] <= 0.5 * base["plane_subs"], (
+        f"coalescing failed: gateway plane submissions "
+        f"{gwr['plane_subs']} > 0.5x uncoalesced {base['plane_subs']}"
+    )
+    gws = gwr["gw_stats"]
+    hdr_per_s = n_requests / (gwr["wall_ms"] / 1000)
+    return {
+        "metric": "cfg10 light-client gateway coalesced serving",
+        "value": round(hdr_per_s),
+        "unit": "headers/sec",
+        "vs_baseline": round(base["wall_ms"] / gwr["wall_ms"], 2),
+        "extra": {
+            "clients": n_clients,
+            "requests": n_requests,
+            "client_p50_ms": round(p50(gwr["lats"]), 2),
+            "client_p99_ms": round(
+                float(np.percentile(gwr["lats"], 99)), 2),
+            "uncoalesced_p50_ms": round(p50(base["lats"]), 2),
+            "plane_subs_gateway": gwr["plane_subs"],
+            "plane_subs_uncoalesced": base["plane_subs"],
+            "coalesce_sub_ratio": round(
+                gwr["plane_subs"] / max(1, base["plane_subs"]), 3),
+            "verifies": gws["verifies"],
+            "coalesced_requests": gws["coalesced"],
+            "verifies_coalesced_ratio": round(
+                gws["verifies"] / max(1, gws["requests"]), 3),
+            "cache": {k: gws["cache"][k]
+                      for k in ("hits", "misses", "size")},
+            "gateway_lane_rows": gwr["gateway_rows"],
+            "uncoalesced_wall_ms": round(base["wall_ms"], 1),
+            "gateway_wall_ms": round(gwr["wall_ms"], 1),
+            "note": "uncoalesced = N private light clients, same "
+                    "targets, same host plane; submissions counted "
+                    "from the flush ledger",
+        },
+    }
+
+
 def headline_10k():
     """The driver metric: 10k-validator VerifyCommitLight fused p50."""
     vs, commit, bid = make_ed_commit(10_000)
@@ -1109,11 +1295,60 @@ def smoke_vote_plane(n_sigs=32):
     }
 
 
+def smoke_gateway(n_clients=4, n_heights=6, n_vals=3):
+    """cfg10's miniature: the gateway end to end on the host plane —
+    coalescer, shared store, LRU, and the ledger-counted coalescing
+    assertion — at tier-1-safe scale (pure-Python crypto, no jax)."""
+    blocks, provider, now_s = _make_light_chain(n_heights, n_vals,
+                                                seed=9700)
+    targets = [n_heights - 2, n_heights]
+
+    def targets_of(k):
+        return targets
+
+    base = _gateway_run(blocks, provider, now_s, n_clients, targets_of,
+                        use_gateway=False, ledger_cap=256)
+    gwr = _gateway_run(blocks, provider, now_s, n_clients, targets_of,
+                       use_gateway=True, ledger_cap=256)
+    assert gwr["plane_subs"] <= 0.5 * base["plane_subs"], (
+        gwr["plane_subs"], base["plane_subs"])
+    gws = gwr["gw_stats"]
+    assert gws["verifies"] < gws["requests"], gws
+    assert gwr["gateway_rows"] > 0, "gateway rows never rode its lane"
+    n_requests = len(gwr["lats"])
+    return {
+        "metric": "cfg10_smoke light-client gateway",
+        "value": round(n_requests / (gwr["wall_ms"] / 1000)),
+        "unit": "headers/sec",
+        "vs_baseline": None,
+        "extra": {
+            "clients": n_clients,
+            "plane_subs_gateway": gwr["plane_subs"],
+            "plane_subs_uncoalesced": base["plane_subs"],
+            "verifies": gws["verifies"],
+            "requests": gws["requests"],
+            "cache_hits": gws["cache"]["hits"],
+        },
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
-                 ("cfg6_smoke", smoke_vote_plane)]
+                 ("cfg6_smoke", smoke_vote_plane),
+                 ("cfg10_smoke", smoke_gateway)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
+
+# the full (TPU-host) config set, in run order — tools/bench_history.py
+# seeds its per-config rows from these names so a config added here is
+# trackable from the next bench round onward even before any BENCH
+# file records it
+FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
+                ("cfg3", cfg3_mixed), ("cfg4", cfg4_streaming),
+                ("cfg5", cfg5_light_secp), ("cfg6", cfg6_vote_plane),
+                ("cfg7", cfg7_pack_only), ("cfg8", cfg8_multichip_smoke),
+                ("cfg9", cfg9_sustained), ("cfg10", cfg10_gateway)]
+FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 
 def main(argv=None):
@@ -1187,13 +1422,7 @@ def main(argv=None):
     watch = CompileWatch()
     watch.arm()
 
-    for name, fn in [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
-                     ("cfg3", cfg3_mixed), ("cfg4", cfg4_streaming),
-                     ("cfg5", cfg5_light_secp),
-                     ("cfg6", cfg6_vote_plane),
-                     ("cfg7", cfg7_pack_only),
-                     ("cfg8", cfg8_multichip_smoke),
-                     ("cfg9", cfg9_sustained)]:
+    for name, fn in FULL_CONFIGS:
         traced = bool(args.trace_out) and name in TRACED_CONFIGS
         if traced:
             tracing.enable(capacity=1 << 18)

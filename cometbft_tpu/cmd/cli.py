@@ -107,6 +107,7 @@ def build_node(home: str, cfg=None):
         batch_fn=cfg.crypto.batch_fn(),
         verify_plane=cfg.verify_plane,
         mempool_config=cfg.mempool,
+        lightgate=cfg.lightgate,
         p2p=True,
         node_key=NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json")),
         blocksync=cfg.base.blocksync,

@@ -154,6 +154,13 @@ class VerifyPlaneConfig:
     bulk_window_ms: float = 0.0
     bulk_max_queue: int = 0
     bulk_deadline_ms: float = 250.0
+    # QoS GATEWAY lane (light-client gateway header verifies): drains
+    # after CONSENSUS, ahead of BULK; window 0 = 2x window_ms, queue
+    # bound 0 = max_queue, shed deadline answered with explicit
+    # retry-hinted Overloaded verdicts (0 disables deadline shedding)
+    gateway_window_ms: float = 0.0
+    gateway_max_queue: int = 0
+    gateway_deadline_ms: float = 500.0
 
     def build(self, metrics=None):
         """A VerifyPlane per this config, or None when disabled."""
@@ -168,6 +175,38 @@ class VerifyPlaneConfig:
             bulk_window_ms=self.bulk_window_ms or None,
             bulk_max_queue=self.bulk_max_queue or None,
             bulk_deadline_ms=self.bulk_deadline_ms,
+            gateway_window_ms=self.gateway_window_ms or None,
+            gateway_max_queue=self.gateway_max_queue or None,
+            gateway_deadline_ms=self.gateway_deadline_ms,
+        )
+
+
+@dataclass
+class LightGateConfig:
+    """The light-client gateway (cometbft_tpu.lightgate): serve
+    skipping verification to many concurrent light clients with
+    request coalescing, a shared trusted store, and a verified-pair
+    LRU. `enable = true` mounts it on the node and exposes the
+    lightgate_* JSON-RPC routes."""
+
+    enable: bool = False
+    cache_size: int = 4096          # verified (trusted, target) pairs
+    trusting_period: float = 14 * 24 * 3600.0
+    coalesce_timeout: float = 30.0  # follower wait on a shared flight
+    max_batch_headers: int = 64     # heights per lightgate_headers call
+
+    def build(self, node):
+        """A LightGateway mounted on `node`, or None when disabled."""
+        if not self.enable:
+            return None
+        from cometbft_tpu.lightgate import LightGateway
+
+        return LightGateway.for_node(
+            node,
+            cache_size=self.cache_size,
+            trusting_period=self.trusting_period,
+            coalesce_timeout=self.coalesce_timeout,
+            max_batch_headers=self.max_batch_headers,
         )
 
 
@@ -225,6 +264,7 @@ class Config:
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     verify_plane: VerifyPlaneConfig = field(
         default_factory=VerifyPlaneConfig)
+    lightgate: LightGateConfig = field(default_factory=LightGateConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
 
@@ -250,9 +290,19 @@ class Config:
             raise ConfigError(
                 "[verify_plane] max_queue must be >= max_batch")
         for name in ("bulk_window_ms", "bulk_max_queue",
-                     "bulk_deadline_ms"):
+                     "bulk_deadline_ms", "gateway_window_ms",
+                     "gateway_max_queue", "gateway_deadline_ms"):
             if getattr(self.verify_plane, name) < 0:
                 raise ConfigError(f"[verify_plane] {name} must be >= 0")
+        lg = self.lightgate
+        if lg.cache_size < 1:
+            raise ConfigError("[lightgate] cache_size must be >= 1")
+        if lg.trusting_period <= 0:
+            raise ConfigError("[lightgate] trusting_period must be > 0")
+        if lg.coalesce_timeout <= 0:
+            raise ConfigError("[lightgate] coalesce_timeout must be > 0")
+        if lg.max_batch_headers < 1:
+            raise ConfigError("[lightgate] max_batch_headers must be >= 1")
         mp = self.mempool
         if mp.size < 1:
             raise ConfigError("[mempool] size must be >= 1")
@@ -299,6 +349,7 @@ def _render(cfg: Config) -> str:
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
+        ("lightgate", cfg.lightgate),
         ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
     ]:
         out.append(f"[{section}]")
@@ -321,6 +372,7 @@ def load_config(path: str) -> Config:
         ("base", cfg.base), ("rpc", cfg.rpc), ("p2p", cfg.p2p),
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
+        ("lightgate", cfg.lightgate),
         ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
     ]:
         for k, val in doc.get(section, {}).items():

@@ -294,20 +294,40 @@ class NodeMetrics:
             "Flushes in the ledger window that degraded to the host "
             "path (dispatch failpoint or in-flight device fault)")
         # QoS lanes (overload resilience): per-lane verified rows, shed
-        # submissions (BULK only — CONSENSUS is never shed), and the
-        # per-lane pending depth sampled at scrape time
+        # submissions (GATEWAY/BULK only — CONSENSUS is never shed),
+        # and the per-lane pending depth sampled at scrape time
         self.plane_lane_rows = r.counter(
             "verifyplane", "lane_rows_total",
             "Signature rows verified per QoS lane "
-            "(lane=consensus|bulk)")
+            "(lane=consensus|gateway|bulk)")
         self.plane_shed = r.counter(
             "verifyplane", "shed_total",
             "Submissions shed with an explicit Overloaded verdict, "
-            "labeled by lane (bulk deadline/queue-bound sheds; "
-            "consensus stays 0 by construction)")
+            "labeled by lane (gateway/bulk deadline/queue-bound "
+            "sheds; consensus stays 0 by construction)")
         self.plane_lane_depth = r.gauge(
             "verifyplane", "lane_queue_depth",
             "Pending signature rows per QoS lane at scrape time")
+        # light-client gateway (cometbft_tpu.lightgate): counters are
+        # SAMPLED at scrape time from the mounted gateway's scrape-safe
+        # stats()/cache_stats() — the gateway has no metrics handle of
+        # its own, and a scrape must stay current even when no request
+        # has arrived since the last one
+        self.lightgate_requests = r.counter(
+            "lightgate", "requests_total",
+            "Gateway serving outcomes sampled at scrape time "
+            "(kind=requests|verifies|coalesced|divergences|overloaded"
+            "|evidence_submitted)")
+        self.lightgate_cache = r.counter(
+            "lightgate", "cache_total",
+            "Verified-pair LRU events "
+            "(kind=hits|misses|evictions|expired)")
+        self.lightgate_cache_entries = r.gauge(
+            "lightgate", "cache_entries",
+            "Verified (trusted, target) pairs currently cached")
+        self.lightgate_store_heights = r.gauge(
+            "lightgate", "trusted_store_heights",
+            "Heights held by the gateway's shared trusted store")
         # mempool
         self.mempool_size = r.gauge("mempool", "size",
                                     "Pending transactions")
@@ -423,6 +443,25 @@ class NodeMetrics:
                         float(s["overlap_frac"]))
                     self.plane_flush_fallbacks.set(
                         float(s["host_fallback"]))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            lg = sys.modules.get("cometbft_tpu.lightgate.gateway")
+            gw = lg and lg.last_gateway()
+            if gw is not None:
+                st = gw.stats()
+                for kind in ("requests", "verifies", "coalesced",
+                             "divergences", "overloaded",
+                             "evidence_submitted"):
+                    self.lightgate_requests._set(
+                        (("kind", kind),), float(st[kind]))
+                cs = st["cache"]
+                for kind in ("hits", "misses", "evictions", "expired"):
+                    self.lightgate_cache._set(
+                        (("kind", kind),), float(cs[kind]))
+                self.lightgate_cache_entries.set(float(cs["size"]))
+                self.lightgate_store_heights.set(
+                    float(st["store_heights"]))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:

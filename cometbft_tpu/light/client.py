@@ -60,7 +60,12 @@ class DivergenceError(LightClientError):
 
 
 class TrustedStore:
-    """In-memory trusted light-block store (light/store/db analog)."""
+    """In-memory trusted light-block store (light/store/db analog).
+
+    Thread-safe: every method takes the store lock, and
+    :meth:`lowest_at_or_above` gives concurrent callers (the gateway's
+    backwards walks) an atomic anchor scan instead of a racy
+    heights()-then-get() sequence."""
 
     def __init__(self):
         self._blocks: Dict[int, LightBlock] = {}
@@ -88,9 +93,31 @@ class TrustedStore:
         with self._lock:
             return sorted(self._blocks)
 
+    def lowest_at_or_above(self, height: int) -> Optional[LightBlock]:
+        """The stored block with the smallest height >= `height`, read
+        atomically (a concurrent delete between a heights() scan and
+        the get() would otherwise hand back None mid-walk)."""
+        with self._lock:
+            above = [h for h in self._blocks if h >= height]
+            if not above:
+                return None
+            return self._blocks[min(above)]
+
 
 class Client:
-    """light.Client (light/client.go:174)."""
+    """light.Client (light/client.go:174).
+
+    Thread-safe for concurrent verification (the light-client gateway
+    shares ONE client across many serving threads): the store is
+    internally locked, the `verifications` counter rides its own lock,
+    and the backwards walk anchors atomically. The client lock is NEVER
+    held across the device-verify wait inside `_verify_one` — two
+    threads bisecting disjoint ranges submit to the verify plane
+    concurrently, so their flushes coalesce and overlap. Bisection
+    state itself (`cur`, the pivot stack) is method-local; concurrent
+    verifications of overlapping ranges duplicate work at worst (the
+    gateway's coalescer exists to prevent exactly that), never corrupt
+    trust."""
 
     def __init__(
         self,
@@ -115,11 +142,35 @@ class Client:
         # any object with the TrustedStore surface; pass light.store.
         # DBStore for durable trust across restarts (light/store/db/db.go)
         self.store = store if store is not None else TrustedStore()
-        # instrumentation for tests/benchmarks (bisection step count)
+        # instrumentation for tests/benchmarks (bisection step count);
+        # += under _count_lock — concurrent gateway verifies must not
+        # lose increments (the coalescing assertions read this)
         self.verifications = 0
+        self._count_lock = threading.Lock()
+        # per-thread step window (step_count): a gateway leader needs
+        # ITS verification's step count, and a delta over the shared
+        # counter would absorb concurrent leaders' increments
+        self._tl_steps = threading.local()
         # divergence reporting hook: receives LightClientAttackEvidence
         # (detector.go -> full-node evidence submission seam)
         self.on_attack_evidence = None
+
+    def _count_verification(self) -> None:
+        with self._count_lock:
+            self.verifications += 1
+        if getattr(self._tl_steps, "active", False):
+            self._tl_steps.steps += 1
+
+    def begin_step_count(self) -> None:
+        """Open a per-THREAD verification-step window (concurrency-safe
+        where a delta over the shared `verifications` counter is not)."""
+        self._tl_steps.active = True
+        self._tl_steps.steps = 0
+
+    def end_step_count(self) -> int:
+        """Close this thread's window; returns steps counted on it."""
+        self._tl_steps.active = False
+        return getattr(self._tl_steps, "steps", 0)
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -161,11 +212,7 @@ class Client:
         """light/client.go:734: headers are trusted backwards through the
         last_block_id hash chain (no signature checks needed — each
         header commits to its parent's hash)."""
-        anchor = None
-        for h in sorted(self.store.heights()):
-            if h >= height:
-                anchor = self.store.get(h)
-                break
+        anchor = self.store.lowest_at_or_above(height)
         if anchor is None:
             raise LightClientError("no trusted header above target")
         if header_expired(anchor.signed_header.header,
@@ -175,7 +222,7 @@ class Client:
         for h in range(anchor.height - 1, height - 1, -1):
             prev = self.primary.light_block(h)
             prev.validate_basic(self.chain_id)
-            self.verifications += 1
+            self._count_verification()
             want = cur.signed_header.header.last_block_id.hash
             if prev.signed_header.header.hash() != want:
                 raise LightClientError(
@@ -190,7 +237,10 @@ class Client:
 
     def _verify_one(self, trusted: LightBlock, new: LightBlock,
                     now: Timestamp) -> None:
-        self.verifications += 1
+        # counter under its own lock; the verify itself (which may wait
+        # on a device flush) runs UNLOCKED so concurrent verifications
+        # coalesce into shared plane flushes
+        self._count_verification()
         if new.height == trusted.height + 1:
             verify_adjacent(
                 self.chain_id, trusted.signed_header, new.signed_header,

@@ -32,13 +32,22 @@ class LightProxy:
                  trusting_period: float = 14 * 24 * 3600.0,
                  host: str = "127.0.0.1", port: int = 0,
                  batch_fn=None, db_path: Optional[str] = None,
-                 insecure_allow_reroot: bool = False):
+                 insecure_allow_reroot: bool = False,
+                 gateway="auto"):
         """insecure_allow_reroot: permit trust-on-first-use RE-rooting
         when a persisted trust root has expired and no --trusted-hash
         is pinned. Off by default: silently letting the primary pick a
         fresh root after downtime is exactly the long-range attack the
         trusting period exists to stop (the reference errors out and
-        demands fresh TrustOptions)."""
+        demands fresh TrustOptions).
+
+        gateway: "auto" (default) adopts the in-process light-client
+        gateway's shared verifier whenever one is mounted — proxy and
+        gateway then agree on ONE TrustedStore, so a height either of
+        them verified is a store hit for the other, and proxy
+        verification rides the gateway's coalescer/LRU. Pass an
+        explicit LightGateway to pin one, or None/False for the legacy
+        standalone path (own client, own store, remote-RPC providers)."""
         from cometbft_tpu.light.client import Client
 
         self.chain_id = chain_id
@@ -48,7 +57,8 @@ class LightProxy:
             from cometbft_tpu.light.store import DBStore
 
             store = DBStore(db_path)
-        self.client = Client(
+        self._gateway_mode = gateway
+        self._own_client = Client(
             chain_id,
             light_provider(chain_id, primary),
             witnesses=[light_provider(chain_id, w)
@@ -65,6 +75,7 @@ class LightProxy:
             )
         self._trusted_height = trusted_height
         self._trusted_hash = trusted_hash
+        self._pin_ok_gw = None  # gateway the pin was checked against
         self._insecure_allow_reroot = insecure_allow_reroot
         self._boot_lock = threading.Lock()
         self.httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
@@ -72,24 +83,84 @@ class LightProxy:
         self.httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
+    # -- shared-verifier resolution ----------------------------------------
+
+    def _resolve_gateway(self):
+        """The LightGateway whose verifier this proxy rides, or None
+        for the legacy standalone path. Resolved per call: a gateway
+        mounted after the proxy started is adopted on the next
+        request. Chain identity is REQUIRED to match — a chain-B proxy
+        must never ride a chain-A gateway and hand out wrong-chain
+        headers stamped verified."""
+        gw = self._gateway_mode
+        if gw in (None, False):
+            return None
+        if gw == "auto":
+            from cometbft_tpu.lightgate import global_gateway
+
+            gw = global_gateway()
+        elif not gw.is_running():
+            gw = None
+        if gw is not None and gw.chain_id != self.chain_id:
+            return None
+        return gw
+
+    @property
+    def client(self):
+        """The verifying light client: the mounted gateway's shared
+        client (single TrustedStore, coalesced verification) when one
+        is available, the proxy's own standalone client otherwise."""
+        gw = self._resolve_gateway()
+        return gw.client if gw is not None else self._own_client
+
     # -- trust bootstrap ---------------------------------------------------
 
-    def _ensure_trust(self) -> None:
+    def _ensure_trust(self):
         """initializeWithTrustOptions (light/client.go): fetch the block
         at the trusted height and pin it against the operator-supplied
-        hash. Lazy so the proxy can start before the primary."""
+        hash. Lazy so the proxy can start before the primary.
+
+        Returns the CLIENT the calling route must serve with — the
+        gateway is resolved exactly once here, so a mount/unmount
+        racing the request can never bootstrap one client and serve
+        from the other.
+
+        With a gateway mounted, trust-root bookkeeping is the
+        GATEWAY's: it self-roots on the chain it serves (sound — the
+        node executed that chain), and the proxy only re-checks the
+        operator's pinned hash against the shared view so a pin
+        mismatch still fails loudly instead of being absorbed by the
+        gateway's root."""
+        gw = self._resolve_gateway()
+        if gw is not None:
+            gw.ensure_root()
+            # the pin is immutable: one successful check per gateway
+            # suffices (identity-keyed — a different gateway mounted
+            # later re-checks)
+            if self._trusted_hash and self._pin_ok_gw is not gw:
+                lb = gw.client.primary.light_block(self._trusted_height)
+                got = lb.signed_header.header.hash()
+                if got != self._trusted_hash:
+                    raise LightProxyError(
+                        f"trusted hash mismatch at height "
+                        f"{self._trusted_height}: got {got.hex()}, "
+                        f"want {self._trusted_hash.hex()}"
+                    )
+                self._pin_ok_gw = gw
+            return gw.client
         with self._boot_lock:
-            latest = self.client.store.latest()
+            client = self._own_client  # legacy standalone path
+            latest = client.store.latest()
             if latest is not None:
                 from cometbft_tpu.light.verifier import header_expired
                 from cometbft_tpu.types.timestamp import Timestamp
 
                 if not header_expired(
                     latest.signed_header.header,
-                    self.client.trusting_period,
+                    client.trusting_period,
                     Timestamp.now(),
                 ):
-                    return
+                    return client
                 # persisted root older than the trusting period: it can
                 # no longer anchor verification. Re-bootstrap from the
                 # operator's TrustOptions if given (the reference's
@@ -131,23 +202,24 @@ class LightProxy:
             if h <= 0:
                 h = int(self.http.status()["sync_info"]
                         ["latest_block_height"])
-            lb = self.client.primary.light_block(h)
+            lb = client.primary.light_block(h)
             got = lb.signed_header.header.hash()
             if self._trusted_hash and got != self._trusted_hash:
                 raise LightProxyError(
                     f"trusted hash mismatch at height {h}: got "
                     f"{got.hex()}, want {self._trusted_hash.hex()}"
                 )
-            self.client.trust_light_block(lb)
+            client.trust_light_block(lb)
+            return client
 
     # -- verified routes (light/rpc/client.go) -----------------------------
 
     def commit(self, height=None):
-        self._ensure_trust()
+        client = self._ensure_trust()  # one resolution per request
         if height is None:
             height = int(self.http.status()["sync_info"]
                          ["latest_block_height"])
-        lb = self.client.verify_light_block_at_height(int(height))
+        lb = client.verify_light_block_at_height(int(height))
         return {
             "signed_header": {
                 "header": serde.header_to_j(lb.signed_header.header),
@@ -158,11 +230,11 @@ class LightProxy:
         }
 
     def block(self, height=None):
-        self._ensure_trust()
+        client = self._ensure_trust()
         if height is None:
             height = int(self.http.status()["sync_info"]
                          ["latest_block_height"])
-        lb = self.client.verify_light_block_at_height(int(height))
+        lb = client.verify_light_block_at_height(int(height))
         bj = self.http.block(int(height))
         block = serde.block_from_json(json.dumps(bj["block"]))
         if block.hash() != lb.signed_header.header.hash():
@@ -174,11 +246,11 @@ class LightProxy:
         return bj
 
     def validators(self, height=None):
-        self._ensure_trust()
+        client = self._ensure_trust()
         if height is None:
             height = int(self.http.status()["sync_info"]
                          ["latest_block_height"])
-        lb = self.client.verify_light_block_at_height(int(height))
+        lb = client.verify_light_block_at_height(int(height))
         return {
             "block_height": lb.height,
             "validators": [
@@ -206,7 +278,7 @@ class LightProxy:
             default_runtime,
         )
 
-        self._ensure_trust()
+        client = self._ensure_trust()
         resp = self.http.call("abci_query", path=path, data=data,
                               prove=True)["response"]
         if int(resp.get("code", 0)) != 0:
@@ -234,7 +306,7 @@ class LightProxy:
         deadline = time.time() + 10.0
         while True:
             try:
-                lb = self.client.verify_light_block_at_height(h + 1)
+                lb = client.verify_light_block_at_height(h + 1)
                 break
             except NoSuchBlockError:
                 if time.time() > deadline:
@@ -258,13 +330,13 @@ class LightProxy:
         proof is validated against the verified header's data_hash."""
         from cometbft_tpu.types.tx import TxProof
 
-        self._ensure_trust()
+        client = self._ensure_trust()
         r = self.http.call("tx", hash=hash, prove=True)
         proof_j = r.get("proof")
         if not proof_j:
             raise LightProxyError("primary returned no tx proof")
         tp = TxProof.from_j(proof_j)
-        lb = self.client.verify_light_block_at_height(int(r["height"]))
+        lb = client.verify_light_block_at_height(int(r["height"]))
         if not tp.validate(lb.signed_header.header.data_hash):
             raise LightProxyError(
                 "tx proof does not verify against the trusted header"
@@ -278,10 +350,11 @@ class LightProxy:
 
     def status(self):
         s = self.http.status()
-        latest = self.client.store.latest()
+        client = self.client
+        latest = client.store.latest()
         s["light_client"] = {
             "trusted_height": latest.height if latest else 0,
-            "witnesses": len(self.client.witnesses),
+            "witnesses": len(client.witnesses),
         }
         return s
 

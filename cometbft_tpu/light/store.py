@@ -113,6 +113,17 @@ class DBStore:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def lowest_at_or_above(self, height: int) -> Optional[LightBlock]:
+        """Atomic anchor scan (TrustedStore parity): the stored block
+        with the smallest height >= `height`."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data FROM light_blocks WHERE height >= ? "
+                "ORDER BY height LIMIT 1",
+                (height,),
+            ).fetchone()
+        return _lb_from_json(row[0]) if row else None
+
     def size(self) -> int:
         with self._lock:
             return self._db.execute(

@@ -42,6 +42,7 @@ class Node(BaseService):
         app_state_bytes: bytes = b"",
         verify_plane=None,
         mempool_config=None,
+        lightgate=None,
     ):
         """statesync_light_client: a light.Client already trusting a root
         header; providing it turns on the statesync->blocksync->consensus
@@ -232,6 +233,19 @@ class Node(BaseService):
         self.consensus.metrics = self.metrics
         self.block_exec.on_retain_height = self.pruner.set_retain_height
 
+        # light-client gateway (config [lightgate];
+        # cometbft_tpu.lightgate): accepts a LightGateConfig, a ready
+        # LightGateway, or None. Mounted on this node's stores/evidence
+        # pool; started with the node and registered as THE global
+        # gateway (the light proxy's shared-verifier path and /metrics
+        # sampling find it there).
+        self.lightgate = None
+        if lightgate is not None:
+            if hasattr(lightgate, "build"):
+                self.lightgate = lightgate.build(self)
+            else:
+                self.lightgate = lightgate
+
         # optional real p2p stack (node/node.go:443-447 createTransport/
         # createSwitch); when absent, `broadcast` (in-memory hub) rules
         self.switch = None
@@ -338,6 +352,10 @@ class Node(BaseService):
 
             self.verify_plane.start()
             verifyplane.set_global_plane(self.verify_plane)
+        if self.lightgate is not None:
+            # after the plane: the gateway's batch_fn rides its GATEWAY
+            # lane from the first request
+            self.lightgate.start()
         self.pruner.start()
         if self.switch is not None:
             self.switch.start()
@@ -402,6 +420,10 @@ class Node(BaseService):
         self.consensus.start()
 
     def on_stop(self) -> None:
+        if self.lightgate is not None:
+            # before the plane stops: in-flight gateway verifies fall
+            # back to the direct host path instead of racing the drain
+            self.lightgate.stop()
         if self.verify_plane is not None:
             from cometbft_tpu import verifyplane
 

@@ -581,6 +581,85 @@ class Routes:
             return plane.dump_flushes()
         return verifyplane.dump_flushes()
 
+    # -- light-client gateway (cometbft_tpu.lightgate; config
+    # [lightgate] mounts it on the node) -------------------------------------
+
+    def _gateway(self):
+        gw = getattr(self.node, "lightgate", None)
+        if gw is None:
+            raise RPCError(
+                -32601, "no light-client gateway mounted (enable it "
+                        "with [lightgate] enable = true)"
+            )
+        return gw
+
+    def lightgate_verify(self, trusted_height, target_height,
+                         trusted_hash=None, claimed=None,
+                         with_validators=None):
+        """Coalesced skipping verification on behalf of a light
+        client: verify `target_height` from the client's
+        `trusted_height` (optionally hash-pinned). `claimed` may carry
+        the signed header the client's OWN primary served it
+        ({"header": .., "commit": ..}); a divergent claim yields a
+        "divergent" verdict and drives LightClientAttackEvidence into
+        the node's evidence pool. Overload is an explicit verdict:
+        {"status": "overloaded", "retry_after_ms": ...} — never a
+        silent drop."""
+        from cometbft_tpu.light.client import NoSuchBlockError
+        from cometbft_tpu.light.verifier import LightClientError
+        from cometbft_tpu.lightgate import GatewayError, GatewayOverloaded
+
+        gw = self._gateway()
+        if isinstance(claimed, str):
+            claimed = json.loads(claimed)
+        pin = bytes.fromhex(trusted_hash) if trusted_hash else None
+        try:
+            return gw.verify(
+                int(trusted_height), int(target_height),
+                trusted_hash=pin, claimed=claimed,
+                with_validators=with_validators in (True, "true", "1", 1),
+            )
+        except GatewayOverloaded as e:
+            return {"status": "overloaded",
+                    "retry_after_ms": e.retry_after_ms,
+                    "log": str(e)}
+        except NoSuchBlockError as e:
+            raise RPCError(-32603, str(e))
+        except (GatewayError, LightClientError) as e:
+            raise RPCError(-32603, f"lightgate: {e}")
+
+    def lightgate_headers(self, heights=None, min_height=None,
+                          max_height=None, with_validators=None):
+        """Batched signed-header serving: either an explicit `heights`
+        list (JSON array, or comma-separated in the URI form) or a
+        [min_height, max_height] range, capped at the gateway's
+        max_batch_headers per call."""
+        gw = self._gateway()
+        if isinstance(heights, str):
+            heights = [int(h) for h in heights.split(",") if h.strip()]
+        if heights is None:
+            if min_height is None or max_height is None:
+                raise RPCError(
+                    -32602, "pass heights=[...] or min_height+max_height"
+                )
+            lo, hi = int(min_height), int(max_height)
+            if hi < lo:
+                raise RPCError(-32602, "max_height < min_height")
+            # clamp BEFORE materializing: a client-controlled range
+            # must never allocate beyond the serving cap (the
+            # `blockchain` route clamps for the same reason)
+            hi = min(hi, lo + gw.max_batch_headers - 1)
+            heights = list(range(lo, hi + 1))
+        return gw.headers(
+            heights,
+            with_validators=with_validators in (True, "true", "1", 1),
+        )
+
+    def lightgate_status(self):
+        """Gateway serving stats: coalescer/cache counters, trusted-
+        store span, in-flight verifications (scrape-safe)."""
+        return self._gateway().stats()
+
 
 _ROUTES = [
     "health", "status", "net_info", "genesis", "genesis_chunked",
@@ -591,6 +670,7 @@ _ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes",
+    "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
 # only served when the server runs with unsafe=True

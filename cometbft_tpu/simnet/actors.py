@@ -108,3 +108,48 @@ def build_light_attack(privs, valset, chain_id: str,
         timestamp=now,
         conflicting_commit=Commit(height, 0, bid, sigs),
     )
+
+
+def forged_claim(privs, valset, chain_id: str, byz_idxs: List[int],
+                 height: int, now: Timestamp) -> dict:
+    """The wire-shaped claim a light client deceived by a lying primary
+    submits to `lightgate_verify`: a forged header at `height` plus the
+    byzantine coalition's commit sealing it ({"header": .., "commit":
+    ..} in serde JSON form). Unlike :func:`build_light_attack` this is
+    the RAW divergent view — the GATEWAY turns it into
+    LightClientAttackEvidence through the light client's
+    _make_attack_evidence path, which is exactly the seam the scenario
+    exercises."""
+    from cometbft_tpu.types import serde
+    from cometbft_tpu.types.block import Header
+
+    header = Header(
+        chain_id=chain_id, height=height, time=now,
+        last_block_id=BlockID(),
+        validators_hash=valset.hash(),
+        next_validators_hash=valset.hash(),
+        proposer_address=valset.validators[0].address,
+        app_hash=hashlib.sha256(b"simnet-forged-app-%d" % height
+                                ).digest(),
+    )
+    hh = header.hash()
+    bid = BlockID(hh, PartSetHeader(1, hh))
+    sigs = [CommitSig.absent() for _ in range(len(valset))]
+    for idx in byz_idxs:
+        priv = privs[idx]
+        addr = priv.pub_key().address()
+        vidx, val = valset.get_by_address(addr)
+        assert val is not None, "byzantine index not in validator set"
+        v = Vote(
+            vote_type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+            block_id=bid, timestamp=now, validator_address=addr,
+            validator_index=vidx,
+        )
+        sigs[vidx] = CommitSig(
+            BLOCK_ID_FLAG_COMMIT, addr, now,
+            priv.sign(v.sign_bytes(chain_id)),
+        )
+    return {
+        "header": serde.header_to_j(header),
+        "commit": serde.commit_to_j(Commit(height, 0, bid, sigs)),
+    }

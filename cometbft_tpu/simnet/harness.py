@@ -68,6 +68,10 @@ class Simnet:
         # scenarios assert overload verdicts are EXPLICIT (code +
         # retry hint), never silent drops
         self.flood_results: List[Dict] = []
+        # every gateway_sync client verdict, in sync order — the
+        # forged-header scenario asserts honest clients complete and
+        # the whole verdict stream replays byte-identically
+        self.gateway_results: List[Dict] = []
         # flush-ledger position at sim start: failure blobs attach the
         # ledger tail only if it advanced during THIS simulation
         from cometbft_tpu import verifyplane
@@ -173,6 +177,8 @@ class Simnet:
             net.nodes[op["node"]].garbage_budget += int(op.get("votes", 1))
         elif kind == "light_attack":
             self._launch_light_attack(op)
+        elif kind == "gateway_sync":
+            self._launch_gateway_sync(op)
         elif kind == "tx":
             node = net.nodes[op["node"]]
             if node.alive:
@@ -225,6 +231,66 @@ class Simnet:
             tx = sigtx.wrap(priv, payload) if signed else payload
             net.schedule(k / rate, lambda k=k, tx=tx: inject(k, tx),
                          f"flood n{idx}")
+
+    def _launch_gateway_sync(self, op: Dict) -> None:
+        """Mount a light-client gateway on the target node and drive K
+        client syncs through it at fixed sim times. Synchronous on the
+        scheduler thread (no plane runs in the simnet, so gateway
+        verification takes the inline host path) — same (seed,
+        schedule) therefore yields a byte-identical verdict stream.
+        Forged clients submit a lying-primary claim; the gateway's
+        divergence path feeds the node's evidence pool and the evidence
+        gossips like the node's own (consensus-found) evidence would."""
+        net = self.net
+        idx = int(op["node"])
+        snode = net.nodes[idx]
+        if not snode.alive:
+            return
+        from cometbft_tpu.lightgate import LightGateway
+
+        gw = getattr(snode, "lightgate", None)
+        if gw is None:
+            with net._node_scope(snode):
+                gw = LightGateway.for_node(snode.node)
+                gw.start(register=False)
+            snode.lightgate = gw
+            gw.on_attack_evidence = snode._gossip_own_evidence
+        clients = int(op["clients"])
+        trusted = int(op.get("trusted", 1))
+        target = int(op["target"])
+        forged = {int(i) for i in op.get("forged", [])}
+        claim = None
+        if forged:
+            claim = actors.forged_claim(
+                net.privs, net.genesis.validators, net.chain_id,
+                [int(i) for i in op["byz"]], target, net._sim_now(),
+            )
+        base = len(self.gateway_results)
+
+        def sync(k: int) -> None:
+            if not snode.alive:
+                self.gateway_results.append(
+                    {"seq": base + k, "at": net.now, "status": None,
+                     "log": "gateway node dead"})
+                return
+            with net._node_scope(snode):
+                try:
+                    v = gw.verify(trusted, target,
+                                  claimed=claim if k in forged else None)
+                    rec = {"seq": base + k, "at": net.now,
+                           "status": v["status"],
+                           "target_hash": v["target_hash"],
+                           "cached": v["cached"],
+                           "evidence_added": v.get("evidence_added")}
+                except Exception as e:  # noqa: BLE001 - verdict stream
+                    rec = {"seq": base + k, "at": net.now,
+                           "status": "error", "log": repr(e)[:200]}
+            self.gateway_results.append(rec)
+            net._pump(snode)
+
+        for k in range(clients):
+            net.schedule(k * 0.002, lambda k=k: sync(k),
+                         f"gateway_sync n{idx}")
 
     def _launch_light_attack(self, op: Dict) -> None:
         net = self.net

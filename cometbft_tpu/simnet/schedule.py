@@ -32,6 +32,17 @@ Op catalog (each op is a plain dict, `at` in simulated seconds):
       byz validators at height h) to node i as evidence gossip.
   {"at": t, "op": "tx", "node": i, "data": "<hex>"}
       Inject a transaction into node i's mempool.
+  {"at": t, "op": "gateway_sync", "node": i, "clients": k,
+   "trusted": h0, "target": h, "forged": [..], "byz": [..]}
+      Mount a light-client gateway on node i (cometbft_tpu.lightgate)
+      and drive k client syncs through it at fixed sim times: each
+      client asks to verify `target` from `trusted`. Clients whose
+      index is listed in "forged" submit a forged claimed header
+      sealed by the "byz" validators (a lying-primary feed) — the
+      gateway must answer them with divergent verdicts, push
+      LightClientAttackEvidence through the node's evidence pool, and
+      keep serving the honest clients. Every verdict is recorded on
+      Simnet.gateway_results (replay-assertable).
   {"at": t, "op": "flood", "node": i, "rate": txs_per_sim_second,
    "duration": s, "signed": bool, "size": payload_bytes}
       Open-loop sustained tx stream into node i's broadcast_tx path:
@@ -48,7 +59,8 @@ import json
 from typing import Dict, List
 
 OPS = ("partition", "heal", "link", "kill", "restart", "failpoint",
-       "equivocate", "garbage", "light_attack", "tx", "flood")
+       "equivocate", "garbage", "light_attack", "gateway_sync", "tx",
+       "flood")
 
 _LINK_KEYS = ("drop", "delay", "jitter", "dup", "reorder")
 
@@ -86,8 +98,28 @@ def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
         # selector otherwise validates fine and KeyErrors mid-simulation
         # (a replay-blob failure instead of this loud ScheduleError)
         if kind in ("kill", "restart", "failpoint", "equivocate",
-                    "garbage", "tx", "flood") and "node" not in op:
+                    "garbage", "tx", "flood", "gateway_sync") \
+                and "node" not in op:
             raise ScheduleError(f"{kind} requires a node in {op!r}")
+        if kind == "gateway_sync":
+            if int(op.get("clients", 0)) < 1:
+                raise ScheduleError(
+                    f"gateway_sync needs clients >= 1 in {op!r}")
+            if int(op.get("target", 0)) < 1:
+                raise ScheduleError(
+                    f"gateway_sync needs target >= 1 in {op!r}")
+            forged = op.get("forged", [])
+            if not isinstance(forged, (list, tuple)):
+                raise ScheduleError(
+                    f"forged must be a list of client indices in {op!r}")
+            for i in forged:
+                if not 0 <= int(i) < int(op["clients"]):
+                    raise ScheduleError(
+                        f"forged client index out of range in {op!r}")
+            if forged and not op.get("byz"):
+                raise ScheduleError(
+                    f"gateway_sync with forged clients needs byz "
+                    f"signers in {op!r}")
         if kind == "light_attack" and "target" not in op:
             raise ScheduleError(
                 f"light_attack requires a target in {op!r}")

@@ -9,7 +9,9 @@ fuses per-group voting-power tallies into the same pass.
 from cometbft_tpu.verifyplane.plane import (
     LANE_BULK,
     LANE_CONSENSUS,
+    LANE_GATEWAY,
     LANES,
+    SHEDDABLE_LANES,
     FlushLedger,
     PlaneError,
     PlaneOverloaded,
@@ -31,7 +33,9 @@ from cometbft_tpu.verifyplane.plane import (
 __all__ = [
     "LANE_BULK",
     "LANE_CONSENSUS",
+    "LANE_GATEWAY",
     "LANES",
+    "SHEDDABLE_LANES",
     "FlushLedger",
     "PlaneError",
     "PlaneOverloaded",

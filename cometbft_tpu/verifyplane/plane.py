@@ -31,20 +31,25 @@ kernel shapes from ops/), max_queue bounds memory and provides
 backpressure — a full queue blocks submitters (or raises PlaneQueueFull
 for non-blocking callers, who then verify inline on the host).
 
-QoS lanes (overload resilience): every submission rides one of two
+QoS lanes (overload resilience): every submission rides one of three
 priority classes.  CONSENSUS (the default: gossiped votes, commits,
-light-client headers) owns the flush window — its oldest submission's
-age is what triggers a flush, and its rows drain first.  BULK (today
-mempool CheckTx; blocksync backfill keeps its own pinned pipeline and
-does not ride the plane) fills whatever capacity a flush has left, plus
-a small guaranteed anti-starvation quantum, and coalesces under its own
-longer window when no consensus traffic is pending.  The BULK queue is
-separately bounded and deadline-aware: a BULK submission that cannot be
-served before `bulk_deadline_ms` is SHED with an explicit
-PlaneOverloaded verdict (never a silent drop) carrying a retry-after
-hint, so a CheckTx flood degrades into fast, honest rejections instead
-of an unbounded queue that starves vote verification.  CONSENSUS
-submissions are never shed.
+the node's own light-client headers) owns the flush window — its
+oldest submission's age is what triggers a flush, and its rows drain
+first.  GATEWAY (the light-client gateway's header verifies on behalf
+of RPC clients — cometbft_tpu.lightgate) drains after CONSENSUS and
+ahead of BULK: client-serving traffic must never delay the node's own
+liveness, but it outranks mempool throughput.  BULK (today mempool
+CheckTx; blocksync backfill keeps its own pinned pipeline and does not
+ride the plane) fills whatever capacity a flush has left.  Each
+non-consensus lane gets a small guaranteed anti-starvation quantum and
+coalesces under its own longer window when no higher-priority traffic
+is pending.  GATEWAY and BULK queues are separately bounded and
+deadline-aware: a submission that cannot be served before its lane
+deadline is SHED with an explicit PlaneOverloaded verdict (never a
+silent drop) carrying a retry-after hint, so a CheckTx flood — or a
+thundering herd of light clients — degrades into fast, honest
+rejections instead of an unbounded queue that starves vote
+verification.  CONSENSUS submissions are never shed.
 
 Failure injection: the `verifyplane.dispatch` failpoint fires at the
 top of every flush; a raised fault must degrade that flush to the
@@ -74,17 +79,25 @@ fp.register("verifyplane.dispatch",
 DISPATCH_LOG_MAX = 64       # flush-composition ring kept for tests/ops
 
 # -- QoS lanes --------------------------------------------------------------
-# CONSENSUS: liveness-critical verification (votes, commits, light
-# headers) — owns the flush window, drains first, never shed.
+# CONSENSUS: liveness-critical verification (votes, commits, the node's
+# own light headers) — owns the flush window, drains first, never shed.
+# GATEWAY: light-client-gateway header verifies on behalf of RPC
+# clients (cometbft_tpu.lightgate) — drains after CONSENSUS, ahead of
+# BULK; separately bounded, shed past its deadline.
 # BULK: throughput traffic (today: mempool CheckTx) — fills leftover
 # flush capacity, separately bounded, shed past its deadline.
 LANE_CONSENSUS = "consensus"
+LANE_GATEWAY = "gateway"
 LANE_BULK = "bulk"
-LANES = (LANE_CONSENSUS, LANE_BULK)
+LANES = (LANE_CONSENSUS, LANE_GATEWAY, LANE_BULK)
+# lanes that may be answered with an explicit Overloaded shed verdict
+# (CONSENSUS is never shed by construction)
+SHEDDABLE_LANES = (LANE_GATEWAY, LANE_BULK)
 # anti-starvation: even a flush filled to max_batch with CONSENSUS rows
-# carries up to max_batch // BULK_QUANTUM_DIV extra BULK rows, so a
-# sustained consensus storm degrades BULK to 1/(DIV+1) of capacity
-# instead of zero (weighted priority, not absolute)
+# carries up to max_batch // BULK_QUANTUM_DIV extra rows PER lower
+# lane, so a sustained consensus storm degrades GATEWAY/BULK to a
+# guaranteed slice of capacity instead of zero (weighted priority, not
+# absolute)
 BULK_QUANTUM_DIV = 8
 LANE_WAIT_WINDOW = 4096     # per-lane submit-to-result samples kept
 
@@ -123,10 +136,10 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 # the ring slot" is literal, not approximate.
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
  _L_COLLECT, _L_SETTLE, _L_OVER, _L_PATH, _L_BRK, _L_SMISS,
- _L_DEPTH, _L_CROWS, _L_BROWS, _L_SHED) = range(17)
+ _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED) = range(18)
 # internal slots past the FIELDS window: two ns stamps + the clock
 # generation they were taken under (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN = 17, 18, 19
+_L_T0NS, _L_TPACKED, _L_GEN = 18, 19, 20
 
 
 class FlushLedger:
@@ -138,15 +151,15 @@ class FlushLedger:
     pack overlapped an airborne flight, the dispatch path taken, the
     breaker state observed at stage time, staging-pool misses charged
     to this flush, the queue depth left behind, the per-lane row split
-    (c_rows CONSENSUS / b_rows BULK), and how many BULK submissions
-    were shed at this drain. Written by the dispatcher even when
-    tracing is off; read by /dump_flushes, the scrape-time /metrics
-    percentiles, and simnet replay blobs."""
+    (c_rows CONSENSUS / g_rows GATEWAY / b_rows BULK), and how many
+    sheddable-lane submissions were shed at this drain. Written by the
+    dispatcher even when tracing is off; read by /dump_flushes, the
+    scrape-time /metrics percentiles, and simnet replay blobs."""
 
     FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
               "flight_ms", "collect_ms", "settle_ms", "overlapped",
               "path", "breaker", "staging_miss", "depth",
-              "c_rows", "b_rows", "shed")
+              "c_rows", "g_rows", "b_rows", "shed")
 
     __slots__ = ("_ring",)
 
@@ -217,6 +230,7 @@ class FlushLedger:
                 paths.get(p, 0) for p in (PATH_FAILPOINT,
                                           PATH_FUSED_FALLBACK)),
             "lanes": {LANE_CONSENSUS: int(sum(cols["c_rows"])),
+                      LANE_GATEWAY: int(sum(cols["g_rows"])),
                       LANE_BULK: int(sum(cols["b_rows"]))},
             "shed": int(sum(cols["shed"])),
         }
@@ -399,7 +413,10 @@ class VerifyPlane:
                  use_device: Optional[bool] = None,
                  bulk_window_ms: Optional[float] = None,
                  bulk_max_queue: Optional[int] = None,
-                 bulk_deadline_ms: float = 250.0):
+                 bulk_deadline_ms: float = 250.0,
+                 gateway_window_ms: Optional[float] = None,
+                 gateway_max_queue: Optional[int] = None,
+                 gateway_deadline_ms: float = 500.0):
         from cometbft_tpu.crypto import batch as cbatch
         from cometbft_tpu.libs.staging import StagingPool
 
@@ -414,6 +431,27 @@ class VerifyPlane:
         self.bulk_max_queue = (self.max_queue if bulk_max_queue is None
                                else max(1, int(bulk_max_queue)))
         self.bulk_deadline = max(0.0, bulk_deadline_ms) / 1000.0
+        # GATEWAY lane QoS knobs: client-facing header verifies — a
+        # shorter window than BULK (an RPC caller is waiting) but still
+        # coalescing-friendly, its own bound, and a more generous shed
+        # deadline (a light-client sync tolerates more latency than a
+        # CheckTx; 0 disables deadline shedding)
+        self.gateway_window = (self.window * 2
+                               if gateway_window_ms is None
+                               else max(0.0, gateway_window_ms) / 1000.0)
+        self.gateway_max_queue = (
+            self.max_queue if gateway_max_queue is None
+            else max(1, int(gateway_max_queue)))
+        self.gateway_deadline = max(0.0, gateway_deadline_ms) / 1000.0
+        # per-lane views the dispatcher and submit path index by lane
+        self.lane_window = {LANE_CONSENSUS: self.window,
+                            LANE_GATEWAY: self.gateway_window,
+                            LANE_BULK: self.bulk_window}
+        self.lane_limit = {LANE_CONSENSUS: self.max_queue,
+                           LANE_GATEWAY: self.gateway_max_queue,
+                           LANE_BULK: self.bulk_max_queue}
+        self.lane_deadline = {LANE_GATEWAY: self.gateway_deadline,
+                              LANE_BULK: self.bulk_deadline}
         self.metrics = metrics
         self._kernels = kernels
         self._breaker = breaker if breaker is not None \
@@ -513,13 +551,15 @@ class VerifyPlane:
             # shutdown time went (and survive into post-stop dumps)
             c_rows = sum(len(s.rows) for s in settle
                          if s.lane == LANE_CONSENSUS)
+            g_rows = sum(len(s.rows) for s in settle
+                         if s.lane == LANE_GATEWAY)
             self.ledger.record([
                 next(self._flush_seq), round(t0 / 1e6, 3), len(rows),
                 len(settle), 0.0, 0.0, 0.0,
                 round((t1 - t0) / 1e6, 3),
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
                 False, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
-                c_rows, len(rows) - c_rows, 0,
+                c_rows, g_rows, len(rows) - c_rows - g_rows, 0,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -562,11 +602,13 @@ class VerifyPlane:
         table device path for valset-backed groups; row 0 must be the
         power-bearing signature (the vote; extensions follow).
 
-        `lane` picks the QoS class. BULK submissions over the lane's
-        queue bound raise PlaneOverloaded immediately when non-blocking
-        (the explicit shed verdict, with a retry-after hint) instead of
-        PlaneQueueFull, and may later be shed by the dispatcher if they
-        age past bulk_deadline_ms before a flush can take them."""
+        `lane` picks the QoS class. GATEWAY/BULK submissions over the
+        lane's queue bound raise PlaneOverloaded immediately when
+        non-blocking (the explicit shed verdict, with a retry-after
+        hint) instead of PlaneQueueFull, and may later be shed by the
+        dispatcher if they age past the lane's deadline before a flush
+        can take them. A blocking sheddable-lane submission whose
+        backpressure wait times out is shed the same explicit way."""
         if lane not in LANES:
             raise ValueError(f"unknown verify-plane lane {lane!r}")
         rows = list(rows)
@@ -575,8 +617,7 @@ class VerifyPlane:
         if not self._running or self.in_dispatcher():
             raise PlaneStopped("verify plane not accepting submissions")
         sub = _Submission(rows, group, power, counted, vidx, lane=lane)
-        limit = (self.max_queue if lane == LANE_CONSENSUS
-                 else self.bulk_max_queue)
+        limit = self.lane_limit[lane]
         deadline = time.monotonic() + DEFAULT_RESULT_TIMEOUT
         with self._cv:
             # backpressure gates on what is already queued in THIS lane
@@ -585,18 +626,25 @@ class VerifyPlane:
             while self._running and self._pending_rows[lane] and \
                     self._pending_rows[lane] + len(rows) > limit:
                 if not block:
-                    if lane == LANE_BULK:
-                        self._shed_count(1)
+                    if lane in SHEDDABLE_LANES:
+                        self._shed_count(1, lane)
                         raise PlaneOverloaded(
-                            f"verify plane bulk lane full "
-                            f"({self.bulk_max_queue} rows)",
-                            retry_after_ms=self._retry_hint_ms(),
+                            f"verify plane {lane} lane full "
+                            f"({limit} rows)",
+                            retry_after_ms=self._retry_hint_ms(lane),
                         )
                     raise PlaneQueueFull(
                         f"verify plane queue full ({limit} rows)"
                     )
                 if not self._cv.wait(timeout=deadline - time.monotonic()) \
                         and time.monotonic() >= deadline:
+                    if lane in SHEDDABLE_LANES:
+                        self._shed_count(1, lane)
+                        raise PlaneOverloaded(
+                            f"verify plane {lane} backpressure wait "
+                            f"timed out",
+                            retry_after_ms=self._retry_hint_ms(lane),
+                        )
                     raise PlaneQueueFull(
                         "verify plane backpressure wait timed out"
                     )
@@ -614,14 +662,14 @@ class VerifyPlane:
         return sub.future
 
     def _depth_locked(self) -> int:
-        return (self._pending_rows[LANE_CONSENSUS]
-                + self._pending_rows[LANE_BULK])
+        return sum(self._pending_rows[lane] for lane in LANES)
 
-    def _retry_hint_ms(self) -> float:
-        """Honest backoff hint for shed BULK callers: the bulk deadline
-        is the time scale on which the backlog either clears or sheds,
-        so retrying sooner than that is guaranteed wasted work."""
-        return round(max(self.bulk_deadline, self.bulk_window) * 1000, 1)
+    def _retry_hint_ms(self, lane: str = LANE_BULK) -> float:
+        """Honest backoff hint for shed callers: the lane's deadline is
+        the time scale on which its backlog either clears or sheds, so
+        retrying sooner than that is guaranteed wasted work."""
+        return round(max(self.lane_deadline.get(lane, 0.0),
+                         self.lane_window[lane]) * 1000, 1)
 
     def _shed_count(self, n: int, lane: str = LANE_BULK) -> None:
         # dedicated lock: the submit path sheds while HOLDING _cv and
@@ -664,11 +712,20 @@ class VerifyPlane:
             with self._cv:
                 while self._running:
                     cq = self._pending[LANE_CONSENSUS]
-                    bq = self._pending[LANE_BULK]
+                    waitq = wait_lane = None
+                    if not cq:
+                        # highest-priority sheddable lane with traffic
+                        # coalesces under its own longer window
+                        for lane in SHEDDABLE_LANES:
+                            if self._pending[lane]:
+                                waitq, wait_lane = \
+                                    self._pending[lane], lane
+                                break
                     if cq:
-                        # CONSENSUS owns the flush window: a full BULK
-                        # queue can never delay a consensus flush past
-                        # its deadline — bulk rows only ride along
+                        # CONSENSUS owns the flush window: full GATEWAY
+                        # or BULK queues can never delay a consensus
+                        # flush past its deadline — their rows only
+                        # ride along
                         age = time.perf_counter() - cq[0].t_submit
                         if (inflight is not None
                                 or age >= self.window
@@ -676,53 +733,54 @@ class VerifyPlane:
                                 >= self.max_batch):
                             break
                         self._cv.wait(timeout=self.window - age)
-                    elif bq:
-                        # BULK-only: coalesce under the longer bulk
-                        # window (batch fullness over latency)
-                        age = time.perf_counter() - bq[0].t_submit
+                    elif waitq is not None:
+                        win = self.lane_window[wait_lane]
+                        age = time.perf_counter() - waitq[0].t_submit
                         if (inflight is not None
-                                or age >= self.bulk_window
-                                or self._pending_rows[LANE_BULK]
+                                or age >= win
+                                or self._pending_rows[wait_lane]
                                 >= self.max_batch):
                             break
-                        self._cv.wait(timeout=self.bulk_window - age)
+                        self._cv.wait(timeout=win - age)
                     elif inflight is not None:
                         break  # nothing to pack: settle the flight now
                     else:
                         self._cv.wait(timeout=0.25)
                 if not self._running \
-                        and not self._pending[LANE_CONSENSUS] \
-                        and not self._pending[LANE_BULK]:
+                        and not any(self._pending[lane]
+                                    for lane in LANES):
                     break
-                # deadline sheds first: an aged-out BULK submission is
-                # past the point where verifying it helps anyone (its
-                # RPC caller has backed off) — it must not consume
-                # flush capacity. Resolved below with an EXPLICIT
-                # PlaneOverloaded verdict, never silently dropped.
-                if self.bulk_deadline:
-                    # age on the LEDGER clock (virtual under simnet),
-                    # not perf_counter: a shed is a VERDICT, and the
-                    # soak harness asserts the verdict stream replays
-                    # byte-identically — a real-clock cutoff would make
-                    # it host-load-dependent. In production the ledger
-                    # clock IS the monotonic real clock, so behavior
-                    # there is unchanged. Cross-generation stamps
-                    # (clock swapped mid-queue) are treated as fresh.
-                    bq = self._pending[LANE_BULK]
-                    gen = tracing.clock_gen()
-                    cutoff = tracing.monotonic_ns() \
-                        - int(self.bulk_deadline * 1e9)
-                    while bq and bq[0].clock_gen == gen \
-                            and bq[0].t_submit_led < cutoff:
-                        sub = bq.popleft()
-                        self._pending_rows[LANE_BULK] -= len(sub.rows)
+                # deadline sheds first: an aged-out GATEWAY/BULK
+                # submission is past the point where verifying it helps
+                # anyone (its RPC caller has backed off) — it must not
+                # consume flush capacity. Resolved below with an
+                # EXPLICIT PlaneOverloaded verdict, never silently
+                # dropped. Ages ride the LEDGER clock (virtual under
+                # simnet), not perf_counter: a shed is a VERDICT, and
+                # the soak harness asserts the verdict stream replays
+                # byte-identically — a real-clock cutoff would make it
+                # host-load-dependent. In production the ledger clock
+                # IS the monotonic real clock, so behavior there is
+                # unchanged. Cross-generation stamps (clock swapped
+                # mid-queue) are treated as fresh.
+                gen = tracing.clock_gen()
+                now_ns = tracing.monotonic_ns()
+                for lane in SHEDDABLE_LANES:
+                    if not self.lane_deadline[lane]:
+                        continue
+                    q = self._pending[lane]
+                    cutoff = now_ns - int(self.lane_deadline[lane] * 1e9)
+                    while q and q[0].clock_gen == gen \
+                            and q[0].t_submit_led < cutoff:
+                        sub = q.popleft()
+                        self._pending_rows[lane] -= len(sub.rows)
                         shed.append(sub)
                 # weighted drain: whole CONSENSUS submissions first up
                 # to max_batch rows (a lone oversized submission still
-                # dispatches alone), then BULK fills the remaining
-                # capacity — plus the guaranteed anti-starvation
-                # quantum, so bulk always makes progress even under a
-                # sustained consensus storm
+                # dispatches alone), then GATEWAY and finally BULK fill
+                # the remaining capacity — each with its guaranteed
+                # anti-starvation quantum, so every lane makes progress
+                # even under a sustained higher-priority storm
                 rows = 0
                 cq = self._pending[LANE_CONSENSUS]
                 while cq:
@@ -733,31 +791,33 @@ class VerifyPlane:
                     self._pending_rows[LANE_CONSENSUS] -= nxt
                     rows += nxt
                     batch.append(sub)
-                bq = self._pending[LANE_BULK]
                 quantum = max(1, self.max_batch // BULK_QUANTUM_DIV)
-                budget = max(self.max_batch - rows, quantum)
-                brows = 0
-                while bq:
-                    nxt = len(bq[0].rows)
-                    if batch and brows + nxt > budget:
-                        break
-                    sub = bq.popleft()
-                    self._pending_rows[LANE_BULK] -= nxt
-                    brows += nxt
-                    batch.append(sub)
-                rows += brows
+                for lane in SHEDDABLE_LANES:
+                    q = self._pending[lane]
+                    budget = max(self.max_batch - rows, quantum)
+                    lrows = 0
+                    while q:
+                        nxt = len(q[0].rows)
+                        if batch and lrows + nxt > budget:
+                            break
+                        sub = q.popleft()
+                        self._pending_rows[lane] -= nxt
+                        lrows += nxt
+                        batch.append(sub)
+                    rows += lrows
                 depth = self._depth_locked()
                 if self.metrics is not None:
                     self.metrics.plane_queue_depth.set(depth)
                 self._cv.notify_all()  # wake backpressured submitters
             if shed:
-                self._shed_count(len(shed))
-                hint = self._retry_hint_ms()
                 for sub in shed:
+                    self._shed_count(1, sub.lane)
                     sub.future._fail(PlaneOverloaded(
-                        "verify plane shed bulk submission past its "
-                        f"{round(self.bulk_deadline * 1000, 1)}ms "
-                        "deadline", retry_after_ms=hint,
+                        f"verify plane shed {sub.lane} submission past "
+                        f"its "
+                        f"{round(self.lane_deadline[sub.lane] * 1000, 1)}"
+                        f"ms deadline",
+                        retry_after_ms=self._retry_hint_ms(sub.lane),
                     ))
                 if not batch:
                     # a drain cycle can shed everything and cut no
@@ -769,7 +829,8 @@ class VerifyPlane:
                     self.ledger.record([
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, False, PATH_SHED_ONLY,
-                        self._breaker.state, 0, depth, 0, 0, len(shed),
+                        self._breaker.state, 0, depth, 0, 0, 0,
+                        len(shed),
                     ])
             flight = self._stage(batch, depth, shed_n=len(shed)) \
                 if batch else None
@@ -871,10 +932,13 @@ class VerifyPlane:
         t_min = None
         rows = 0
         c_rows = 0
+        g_rows = 0
         for s in batch:
             rows += len(s.rows)
             if s.lane == LANE_CONSENSUS:
                 c_rows += len(s.rows)
+            elif s.lane == LANE_GATEWAY:
+                g_rows += len(s.rows)
             if s.clock_gen != gen:
                 # stamped under a different clock domain (simnet clock
                 # swapped between submit and flush): unusable for a wait
@@ -889,7 +953,8 @@ class VerifyPlane:
         led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, False,
                PATH_HOST, self._breaker.state, 0, depth,
-               c_rows, rows - c_rows, shed_n, t0, t0, gen]
+               c_rows, g_rows, rows - c_rows - g_rows, shed_n, t0, t0,
+               gen]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path

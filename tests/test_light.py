@@ -404,3 +404,119 @@ def test_proxy_accepts_pinned_hash_reroot(tmp_path):
             proxy2._ensure_trust()
     finally:
         proxy2.httpd.server_close()
+
+
+def test_client_concurrent_access_hammer():
+    """ISSUE 8 satellite: the gateway shares ONE Client across serving
+    threads — hammer it: K threads bisecting random targets while
+    another thread prunes, with no lost verification counts, no
+    exceptions, and a store whose every block still matches the chain.
+    The device-verify wait runs unlocked (coalesced flushes overlap),
+    so this is exactly the concurrency shape the gateway produces."""
+    import random
+    import threading
+
+    keys = keys_for(31, 3)
+    chain = LightChain({h: keys for h in range(1, 25)})
+    c = make_client(chain)
+    targets = [6, 12, 18, 24]
+    errs = []
+    lock = threading.Lock()
+    K = 8
+    barrier = threading.Barrier(K + 1)
+
+    def worker(seed):
+        rng = random.Random(seed)
+        try:
+            barrier.wait()
+            for t in rng.sample(targets, len(targets)):
+                lb = c.verify_light_block_at_height(t, now=NOW)
+                assert lb.height == t
+                assert lb.signed_header.header.hash() == \
+                    chain.blocks[t].signed_header.header.hash()
+        except Exception as e:  # noqa: BLE001 - asserted below
+            with lock:
+                errs.append(repr(e))
+
+    def pruner():
+        barrier.wait()
+        for _ in range(20):
+            c.prune_expired(now=NOW)  # nothing expired: exercises the
+            # heights()/get()/delete() walk against concurrent saves
+
+    threads = [threading.Thread(target=worker, args=(1000 + k,))
+               for k in range(K)] + [threading.Thread(target=pruner)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:3]
+    # every stored block is byte-honest chain state
+    for h in c.store.heights():
+        assert c.store.get(h).signed_header.header.hash() == \
+            chain.blocks[h].signed_header.header.hash()
+    # the locked counter lost no increments: every verification that
+    # saved a NEW height counted at least once, and the counter is at
+    # least the number of distinct verified heights
+    assert c.verifications >= len([h for h in c.store.heights()
+                                   if h > 1])
+    # atomic anchor scan used by backwards verification
+    assert c.store.lowest_at_or_above(7).height in c.store.heights()
+
+
+def test_proxy_rides_mounted_gateway():
+    """ISSUE 8 satellite: with a light-client gateway mounted, the
+    proxy's verify path routes through the SHARED gateway verifier —
+    one TrustedStore for both — and trust bookkeeping is the
+    gateway's. The legacy standalone path stays available behind the
+    gateway=False flag."""
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.lightgate import LightGateway, set_global_gateway
+
+    keys = keys_for(33, 3)
+    chain = LightChain({h: keys for h in range(1, 11)})
+    gw = LightGateway(CHAIN_ID, chain.provider(), trusting_period=1e9,
+                      batch_fn=validation.oracle_batch_fn())
+    gw.client.trust_light_block(chain.blocks[1])
+    gw.start()
+    proxy = LightProxy(CHAIN_ID, "http://127.0.0.1:1")  # never dialed
+    try:
+        # shared verifier: the proxy's client IS the gateway's client
+        assert proxy.client is gw.client
+        out = proxy.commit(height=7)
+        assert out["verified"] is True
+        # the verification landed in the ONE shared store — a gateway
+        # request for the same height is now a pure store hit
+        assert 7 in gw.client.store.heights()
+        v = gw.verify(1, 7)
+        assert v["verify_steps"] == 0
+        # _ensure_trust with a pin re-checks against the shared view
+        proxy._trusted_height = 3
+        proxy._trusted_hash = b"\x13" * 32
+        from cometbft_tpu.light.proxy import LightProxyError
+
+        with pytest.raises(LightProxyError, match="mismatch"):
+            proxy._ensure_trust()
+        proxy._trusted_hash = \
+            chain.blocks[3].signed_header.header.hash()
+        proxy._ensure_trust()  # correct pin passes
+    finally:
+        gw.stop()
+        set_global_gateway(None)
+        proxy.httpd.server_close()
+
+    # unmounted again: the proxy is back on its own standalone client
+    assert proxy.client is proxy._own_client
+
+    # and the legacy flag pins standalone even WITH a gateway mounted
+    gw2 = LightGateway(CHAIN_ID, chain.provider(), trusting_period=1e9,
+                       batch_fn=validation.oracle_batch_fn())
+    gw2.client.trust_light_block(chain.blocks[1])
+    gw2.start()
+    legacy = LightProxy(CHAIN_ID, "http://127.0.0.1:1", gateway=False)
+    try:
+        assert legacy.client is legacy._own_client
+    finally:
+        gw2.stop()
+        set_global_gateway(None)
+        legacy.httpd.server_close()

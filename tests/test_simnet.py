@@ -328,3 +328,51 @@ def test_stale_ledger_tail_skipped(tmp_path):
     assert "flush ledger tail:" not in msg
     blob = json.loads(msg.split("replay:", 1)[1])
     assert blob["seed"] == 13
+
+
+def test_gateway_forged_header_scenario(tmp_path):
+    """ISSUE 8: a light-client gateway mounted on a full node serves K
+    clients while a lying primary feeds a SUBSET of them forged
+    headers. The gateway answers the deceived clients with divergent
+    verdicts, drives LightClientAttackEvidence through the existing
+    pool -> gossip -> block pipeline, honest clients complete their
+    sync untouched — and the whole verdict stream replays
+    byte-identically for the same (seed, schedule)."""
+
+    def run_once(tag):
+        with Simnet(4, seed=37, basedir=str(tmp_path / tag)) as sim:
+            sim.run([], until_height=2, max_time=60.0)
+            sim.run([{"at": sim.net.now + 0.05, "op": "gateway_sync",
+                      "node": 0, "clients": 6, "trusted": 1,
+                      "target": 2, "forged": [1, 4], "byz": [2, 3]}],
+                    max_time=2.0)
+            assert len(sim.gateway_results) == 6
+            ev = sim.assert_evidence_committed(
+                predicate=lambda e: isinstance(
+                    e, LightClientAttackEvidence)
+            )
+            assert ev.conflicting_height == 2
+            assert ev.common_height == 1
+            assert len(ev.byzantine_validators) == 2
+            sim.assert_safety()
+            return sim.gateway_results, ev.hash()
+
+    results, ev_hash = run_once("a")
+    by_seq = {r["seq"]: r for r in results}
+    for k in range(6):
+        if k in (1, 4):
+            assert by_seq[k]["status"] == "divergent", by_seq[k]
+        else:
+            assert by_seq[k]["status"] == "verified", by_seq[k]
+    # ONE attack entered the pool; the duplicate claim deduped there
+    assert sum(1 for r in results if r.get("evidence_added")) == 1
+    # honest clients all landed on the same (true) header
+    honest = {r["target_hash"] for r in results
+              if r["status"] == "verified"}
+    assert len(honest) == 1
+
+    # byte-identical replay: verdict stream AND committed evidence
+    results2, ev_hash2 = run_once("b")
+    assert json.dumps(results, sort_keys=True) == \
+        json.dumps(results2, sort_keys=True)
+    assert ev_hash == ev_hash2

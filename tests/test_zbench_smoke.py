@@ -36,15 +36,20 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 3  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 4  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
-    assert sorted(results) == ["cfg2_smoke", "cfg4_smoke", "cfg6_smoke"]
+    assert sorted(results) == ["cfg10_smoke", "cfg2_smoke",
+                               "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
+    # the cfg10 miniature proved ledger-counted gateway coalescing
+    g = results["cfg10_smoke"]["extra"]
+    assert g["plane_subs_gateway"] <= 0.5 * g["plane_subs_uncoalesced"]
+    assert g["verifies"] < g["requests"]
     # the cfg4 miniature carries the disabled-path hook-cost proof row
     dfp = results["cfg4_smoke"]["extra"]["disabled_flush_path"]
     assert dfp["ledger_bookkeeping_us_per_flush"] > 0

@@ -25,7 +25,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from bench import load_bench_results  # noqa: E402
+from bench import FULL_CONFIG_NAMES, load_bench_results  # noqa: E402
 
 # stable column order: the headline first, then the numbered configs
 _CFG_ORDER = re.compile(r"cfg(\d+)")
@@ -54,9 +54,17 @@ def collect(directory: str, pattern: str) -> dict:
 
 
 def history(rounds: dict) -> dict:
-    """Per-config series across rounds + headline deltas."""
+    """Per-config series across rounds + headline deltas.
+
+    Rows are the union of what the BENCH files recorded and the
+    CURRENT bench's full config set (bench.FULL_CONFIG_NAMES) — a
+    config added this round (cfg9, cfg10, ...) renders as an all-'—'
+    row immediately, so its trajectory is trackable from the next
+    bench round onward instead of silently absent until the first
+    recording."""
     configs = sorted({c for r in rounds.values() for c in r
-                      if not c.startswith("_")}, key=_cfg_key)
+                      if not c.startswith("_")}
+                     | set(FULL_CONFIG_NAMES), key=_cfg_key)
     series = {}
     for cfg in configs:
         pts = []
