@@ -161,6 +161,15 @@ class VerifyPlaneConfig:
     gateway_window_ms: float = 0.0
     gateway_max_queue: int = 0
     gateway_deadline_ms: float = 500.0
+    # Multichip sharded dispatch: mesh = true shards eligible fused
+    # flushes across the local device mesh (per-shard device-resident
+    # valset tables, on-device psum tally — one cross-chip pass for
+    # commits past a single chip's valset ceiling). mesh_devices caps
+    # the fan-out (0 = all local devices); mesh_min_rows keeps small
+    # flushes on one chip.
+    mesh: bool = False
+    mesh_devices: int = 0
+    mesh_min_rows: int = 256
 
     def build(self, metrics=None):
         """A VerifyPlane per this config, or None when disabled."""
@@ -178,6 +187,8 @@ class VerifyPlaneConfig:
             gateway_window_ms=self.gateway_window_ms or None,
             gateway_max_queue=self.gateway_max_queue or None,
             gateway_deadline_ms=self.gateway_deadline_ms,
+            mesh_devices=self.mesh_devices if self.mesh else None,
+            mesh_min_rows=self.mesh_min_rows,
         )
 
 
@@ -291,9 +302,14 @@ class Config:
                 "[verify_plane] max_queue must be >= max_batch")
         for name in ("bulk_window_ms", "bulk_max_queue",
                      "bulk_deadline_ms", "gateway_window_ms",
-                     "gateway_max_queue", "gateway_deadline_ms"):
+                     "gateway_max_queue", "gateway_deadline_ms",
+                     "mesh_devices", "mesh_min_rows"):
             if getattr(self.verify_plane, name) < 0:
                 raise ConfigError(f"[verify_plane] {name} must be >= 0")
+        if self.verify_plane.mesh_devices == 1:
+            raise ConfigError(
+                "[verify_plane] mesh_devices must be 0 (all) or >= 2 — "
+                "a 1-device mesh is just the single-device path")
         lg = self.lightgate
         if lg.cache_size < 1:
             raise ConfigError("[lightgate] cache_size must be >= 1")

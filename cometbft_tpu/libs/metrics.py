@@ -308,6 +308,19 @@ class NodeMetrics:
         self.plane_lane_depth = r.gauge(
             "verifyplane", "lane_queue_depth",
             "Pending signature rows per QoS lane at scrape time")
+        # multichip sharded dispatch: cross-chip flush attribution
+        # (the flush ledger's n_dev column, aggregated)
+        self.plane_shard_flushes = r.counter(
+            "verifyplane", "shard_flushes_total",
+            "Fused flushes dispatched as one cross-chip sharded pass "
+            "over the verify mesh")
+        self.plane_shard_rows = r.counter(
+            "verifyplane", "shard_rows_total",
+            "Signature rows verified by cross-chip sharded flushes")
+        self.plane_shard_ndev = r.gauge(
+            "verifyplane", "shard_devices",
+            "Resolved device fan-out of the verify plane's flush mesh "
+            "(0 = single-device dispatch)")
         # light-client gateway (cometbft_tpu.lightgate): counters are
         # SAMPLED at scrape time from the mounted gateway's scrape-safe
         # stats()/cache_stats() — the gateway has no metrics handle of
@@ -427,6 +440,11 @@ class NodeMetrics:
             if plane is not None:
                 for lane, d in plane.lane_depths().items():
                     self.plane_lane_depth.set(float(d), lane=lane)
+                # shard_devices is NOT sampled here: _flush_mesh sets
+                # the owning plane's registry live at resolution, and
+                # overwriting from the process-global plane would
+                # clobber it (4 -> 0) whenever this node's plane isn't
+                # the global one — same reason sheds aren't sampled
                 # sheds are NOT sampled here: _shed_count inc's the
                 # owning plane's registry live, and overwriting from
                 # the process-global plane would regress the counter

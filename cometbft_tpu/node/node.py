@@ -352,6 +352,20 @@ class Node(BaseService):
 
             self.verify_plane.start()
             verifyplane.set_global_plane(self.verify_plane)
+            if self.verify_plane._mesh_devices is not None:
+                # resolve the flush mesh now so a misconfigured
+                # multichip node reports its real fan-out at START,
+                # not on the first 100k-validator commit (print, not
+                # logging: the cmd/cli start lines are prints too, and
+                # only mesh-configured nodes reach here)
+                self.verify_plane._flush_mesh(
+                    self.verify_plane.mesh_min_rows)
+                print("verify plane mesh: "
+                      + (f"{self.verify_plane.mesh_ndev}-device "
+                         f"sharded dispatch"
+                         if self.verify_plane.mesh_ndev
+                         else "requested but <2 devices; "
+                              "single-device"))
         if self.lightgate is not None:
             # after the plane: the gateway's batch_fn rides its GATEWAY
             # lane from the first request

@@ -399,9 +399,13 @@ _TABLE_LOCK = threading.Lock()
 MAX_INCREMENTAL = 64  # fall back to full rebuild above this delta
 
 # steady-state observability + the zero-copy hot path's regression
-# guard: a healthy consensus stream should be ~all hits
+# guard: a healthy consensus stream should be ~all hits (the shard_*
+# kinds count the per-mesh sharded-table cache the multichip verify
+# plane rides — steady-state sharded flushes must be all shard_hits,
+# i.e. zero table re-uploads)
 _TABLE_STATS = {"hits": 0, "misses": 0, "key_memo_hits": 0,
-                "valset_hits": 0, "valset_misses": 0}
+                "valset_hits": 0, "valset_misses": 0,
+                "shard_hits": 0, "shard_misses": 0}
 
 
 def table_cache_stats() -> dict:
@@ -540,6 +544,108 @@ def table_for_valset(vals) -> ValsetTable:
 
 
 # --------------------------------------------------------------------------
+# sharded tables (multichip verify plane)
+# --------------------------------------------------------------------------
+
+
+class ShardedValsetTable:
+    """One validator set's window table sharded across a device mesh.
+
+    Device d of the mesh holds the table/ok/power columns for
+    validators [d*m_shard, (d+1)*m_shard): tab/ok/power5 are GLOBAL
+    jax arrays carrying the mesh NamedSharding, assembled zero-copy
+    from per-device shards (make_array_from_single_device_arrays), so
+    a sharded flush's jitted step does no resharding and no shard ever
+    leaves its chip. m_shard is a table_pad bucket, which keeps the
+    in-kernel `row mod M -> validator` map intact per device."""
+
+    __slots__ = ("tab", "ok", "power5", "m_shard", "n_dev")
+
+    def __init__(self, tab, ok, power5, m_shard: int, n_dev: int):
+        self.tab = tab
+        self.ok = ok
+        self.power5 = power5
+        self.m_shard = m_shard
+        self.n_dev = n_dev
+
+
+def shard_stride(n_vals: int, n_dev: int) -> int:
+    """Per-device table stride M_s for an n_vals valset over n_dev
+    devices: the table_pad bucket of the per-shard slice. Validator v
+    lives on device v // M_s at local slot v % M_s. The ONE home of
+    the sharded layout math — fused.plan_fused and the table builder
+    must agree on it."""
+    return table_pad(-(-max(n_vals, 1) // max(n_dev, 1)))
+
+
+# (content key, mesh identity) -> ShardedValsetTable. Small: a node
+# serves one live valset per mesh in the steady state; churn evicts.
+_SHARD_CACHE: "OrderedDict[tuple, ShardedValsetTable]" = OrderedDict()
+_SHARD_CACHE_MAX = 4
+
+
+def sharded_table_for_pubs(pub_bytes: Sequence[bytes], powers,
+                           mesh) -> ShardedValsetTable:
+    """The per-shard device-resident window table for (valset, mesh),
+    memoized like table_for_pubs: the content key rides the same
+    identity memo (_memo_cache_key — QuorumGroup's immutable tuples
+    pay the O(valset) digest once), so a steady-state sharded flush
+    uploads NOTHING. Accounting lands in table_cache_stats() under
+    the shard_hits/shard_misses kinds."""
+    from cometbft_tpu.parallel import mesh as pm
+
+    key = (_memo_cache_key(pub_bytes, powers), pm._mesh_key(mesh))
+    with _TABLE_LOCK:
+        t = _SHARD_CACHE.get(key)
+        if t is not None:
+            _SHARD_CACHE.move_to_end(key)
+            _TABLE_STATS["shard_hits"] += 1
+            return t
+        _TABLE_STATS["shard_misses"] += 1
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = list(mesh.devices.flat)
+    n_dev = len(devs)
+    m_s = shard_stride(len(pub_bytes), n_dev)
+    tabs, oks, p5s = [], [], []
+    for d, dev in enumerate(devs):
+        lo = d * m_s
+        chunk = list(pub_bytes[lo:lo + m_s])
+        # pad the shard to exactly m_s slots: b"" keys decompress to
+        # ok=False identity entries, power 0 — dead slots, same as the
+        # single-device table's padding
+        chunk.extend(b"" for _ in range(m_s - len(chunk)))
+        pw = None
+        if powers is not None:
+            pw = list(powers[lo:lo + m_s])
+            pw.extend(0 for _ in range(m_s - len(pw)))
+        # build ON the target device; bypass the single-device LRU so
+        # shard tables (committed to device d) never alias entries a
+        # single-device lookup could serve from the wrong chip
+        with jax.default_device(dev):
+            st = build_table(chunk, pw)
+        tabs.append(jax.device_put(st.tab, dev))
+        oks.append(jax.device_put(st.ok, dev))
+        p5s.append(jax.device_put(st.power5, dev))
+    axis = mesh.axis_names[0]
+    mk = jax.make_array_from_single_device_arrays
+    blocks = m_s // 128 * ENT_BLOCK
+    t = ShardedValsetTable(
+        mk((n_dev * blocks, 128), NamedSharding(mesh, P(axis, None)),
+           tabs),
+        mk((n_dev * m_s,), NamedSharding(mesh, P(axis)), oks),
+        mk((n_dev * m_s, ek.POWER_LIMBS),
+           NamedSharding(mesh, P(axis, None)), p5s),
+        m_s, n_dev,
+    )
+    with _TABLE_LOCK:
+        _SHARD_CACHE[key] = t
+        while len(_SHARD_CACHE) > _SHARD_CACHE_MAX:
+            _SHARD_CACHE.popitem(last=False)
+    return t
+
+
+# --------------------------------------------------------------------------
 # niels-form base comb table (MXU matmul side)
 # --------------------------------------------------------------------------
 
@@ -565,6 +671,24 @@ def base60_dev():
     if _BASE60_DEV is None:
         _BASE60_DEV = jax.device_put(base60_f32())
     return _BASE60_DEV
+
+
+# the [S]B comb replicated across a mesh (the sharded fused flush's
+# base argument): long-lived like base60_dev, one upload per mesh
+_BASE60_REPL: dict = {}
+
+
+def base60_repl(mesh):
+    from cometbft_tpu.parallel import mesh as pm
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = pm._mesh_key(mesh)
+    dev = _BASE60_REPL.get(key)
+    if dev is None:
+        dev = _BASE60_REPL[key] = jax.device_put(
+            base60_f32(), NamedSharding(mesh, P(None, None))
+        )
+    return dev
 
 
 # --------------------------------------------------------------------------
@@ -693,6 +817,23 @@ def _kernel(packed_ref, base_ref, tab_ref, valid_ref, s8_ref, h4_ref):
     valid_ref[:, :] = valid.astype(jnp.int32)
 
 
+def _thresh_from_rows(rows, n_commits: int):
+    """The per-commit thresholds packed into the trailing rows,
+    zero-padded when the slice is short. A single-device caller always
+    packs enough rows (packed_rows_shape); a LANE-SHARDED caller
+    (mesh.sharded_fused_verify) packs ONE zero threshold row — its
+    local slice holds B/n_dev elements, which can undercut
+    n_commits*TALLY_LIMBS for many-group flushes, and real thresholds
+    ride replicated out-of-band (the in-rows quorum output is
+    discarded there). Without the pad, the reshape is a trace-time
+    crash that would falsely trip the device breaker."""
+    flat = rows[V_THRESH:].reshape(-1)
+    need = n_commits * ek.TALLY_LIMBS
+    if flat.size < need:
+        flat = jnp.pad(flat, (0, need - flat.size))
+    return flat[:need].reshape(n_commits, ek.TALLY_LIMBS)
+
+
 @functools.partial(jax.jit, static_argnames=("n_commits",))
 def _verify_tally_cached(rows, tab, ok, power5, base, n_commits: int):
     """Pallas verify with in-kernel table blocks + fused tally.
@@ -740,9 +881,7 @@ def _verify_tally_cached(rows, tab, ok, power5, base, n_commits: int):
     pw = jnp.tile(power5, (reps, 1))[:B]
     counted = (rows[V_FLAGS] >> 2) & 1 != 0
     commit_ids = rows[V_FLAGS] >> 3
-    thresh = rows[V_THRESH:].reshape(-1)[
-        : n_commits * ek.TALLY_LIMBS
-    ].reshape(n_commits, ek.TALLY_LIMBS)
+    thresh = _thresh_from_rows(rows, n_commits)
     tally = ek.tally_core(valid, pw, counted, commit_ids, n_commits)
     return valid, tally, ek.quorum_core(tally, thresh)
 

@@ -13,6 +13,8 @@ psum hierarchically (ICI within pod slice, DCN across hosts).
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -53,20 +55,27 @@ _STEP_CACHE: dict = {}
 # Memoization regression guard (the round-5 MULTICHIP timeout was
 # per-call shard_map rebuilds): every builder counts its probe, so
 # tests — and the bench's multichip smoke — can assert steady-state
-# calls HIT instead of silently re-tracing.
+# calls HIT instead of silently re-tracing. The counters are mutated
+# from the verify plane's dispatcher thread AND from test/bench/scrape
+# probes concurrently, so increments ride one module lock — an
+# unguarded += loses counts exactly when several threads flush at once
+# (the same race the plane's sheds counter fixed in PR 7).
 _CACHE_STATS = {"hits": 0, "misses": 0}
+_STATS_LOCK = threading.Lock()
 
 
 def cache_stats() -> dict:
-    return dict(_CACHE_STATS)
+    with _STATS_LOCK:
+        return dict(_CACHE_STATS)
 
 
 def _cache_get(key):
     fn = _STEP_CACHE.get(key)
-    if fn is not None:
-        _CACHE_STATS["hits"] += 1
-    else:
-        _CACHE_STATS["misses"] += 1
+    with _STATS_LOCK:
+        if fn is not None:
+            _CACHE_STATS["hits"] += 1
+        else:
+            _CACHE_STATS["misses"] += 1
     return fn
 
 
@@ -218,10 +227,18 @@ def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
 def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
                        commit_ids):
     """Pad batch arrays to a multiple of the mesh size and device_put them
-    with the batch sharding (so the jitted step does no host resharding)."""
+    with the batch sharding (so the jitted step does no host resharding).
+
+    Padding rows necessarily carry commit_id=0 (there is no "no commit"
+    id); they are kept out of every tally by construction: counted is
+    cast to bool and the padding region is set False EXPLICITLY (not
+    left to zero-fill), and precheck pads False so the verify core
+    rejects the rows independently. tests/test_mesh.py's padded-vs-
+    unpadded tally regression guards commit 0's sum bit-for-bit."""
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
     padded = pb.padded
+    counted = np.asarray(counted, np.bool_)
     if padded % n_dev:
         extra = n_dev - padded % n_dev
         pad1 = lambda a: np.pad(a, [(0, extra)] + [(0, 0)] * (a.ndim - 1))
@@ -231,7 +248,8 @@ def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
             hdig=pad1(pb.hdig), precheck=pad1(pb.precheck),
         )
         power5 = pad1(np.asarray(power5))
-        counted = pad1(np.asarray(counted))
+        counted = pad1(counted)
+        counted[padded:] = False  # padding rows are never counted
         commit_ids = pad1(np.asarray(commit_ids))
     sh = NamedSharding(mesh, P(axis))
     put = lambda a: jax.device_put(a, sh)
@@ -278,6 +296,61 @@ def sharded_stream_verify(mesh: Mesh, n_commits: int):
         step,
         mesh=mesh,
         in_specs=(P(None, axis), P(), P(), P(), P(), P()),
+        out_specs=(P(axis), P(), P()),
+        unchecked=True,
+    )
+    fn = jax.jit(sharded)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def sharded_fused_verify(mesh: Mesh, n_commits: int):
+    """The verify PLANE's fused flush under shard_map: the cached-table
+    kernel with the VALSET sharded across the mesh.
+
+    Where sharded_stream_verify replicates one table and shards at
+    commit granularity (the blocksync shape: many commits, modest
+    valset), this shards the validator set itself — the 100k-validator
+    commit shape, where ONE commit's valset exceeds a single chip's
+    table budget (table_pad caps at 65536 slots/device). Device d holds
+    the window-table shard for validators [d*M_s, (d+1)*M_s)
+    (ed25519_cached.sharded_table_for_pubs) and its rows slice carries
+    exactly those validators' signatures (fused.shard_positions lays
+    commits out so row `d*B_loc + s*M_s + (v mod M_s)` is validator v's
+    stride-s slot — the in-kernel `row mod M -> validator` map then
+    resolves LOCAL indices with no plumbing). Rows carry GLOBAL commit
+    ids, so each device's partial voting-power tally lands in the right
+    commit slot; one psum over the mesh + a limb re-carry + quorum_core
+    finish every commit's quorum bit ON DEVICE — the fused quorum
+    output generalizes across chips.
+
+    Thresholds ride as a separate replicated argument (the in-rows
+    threshold rows are per-device slices and meaningless sharded; the
+    kernel's own quorum output is discarded). Memoized per
+    (mesh, n_commits); the expensive Pallas program recompiles per
+    (mesh, local-batch-shape) under jit's own cache, exactly like the
+    single-device path's bucket shapes."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    key = ("fused", _mesh_key(mesh), int(n_commits))
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    axis = mesh.axis_names[0]
+
+    def step(rows, tab, ok, power5, base, threshold):
+        valid, local, _ = ec._verify_tally_cached.__wrapped__(
+            rows, tab, ok, power5, base, n_commits
+        )
+        total = _carry_tally(jax.lax.psum(local, axis))
+        quorum = ek.quorum_core(total, threshold)
+        return valid, total, quorum
+
+    sharded = _smap(
+        step,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None), P(axis), P(axis, None),
+                  P(), P()),
         out_specs=(P(axis), P(), P()),
         unchecked=True,
     )

@@ -11,6 +11,18 @@ SAME device pass (ed25519_kernel.tally_core) that verifies the
 signatures — the quorum bit a VoteSet waits on is a kernel output, not
 a host reduction.
 
+Multichip ([verify_plane] mesh knobs): when the plane is configured
+with a >1-device mesh, plan_fused lays the scattered rows out in
+per-device blocks (validator v of stride s lands at
+``d*B_loc + s*M_s + (v mod M_s)`` with d = v // M_s — shard_positions
+is the one home of that math), the valset window table is
+device-resident PER SHARD (ed25519_cached.sharded_table_for_pubs), and
+dispatch_fused launches parallel/mesh.sharded_fused_verify: each chip
+verifies its validators' signatures against its local table shard and
+the voting-power tally psum-reduces ON DEVICE, so the quorum bit is
+still a kernel output — one cross-chip pass for a 100k-validator
+commit (a single chip's table budget caps at 65536 validator slots).
+
 This is the plane's TPU specialization; it is bypassed on CPU backends
 (the interpret-mode cached kernel costs minutes of compile) where the
 generic host path in plane._verify_rows serves the same semantics.
@@ -21,7 +33,14 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-MAX_FUSED_ROWS = 65536
+MAX_FUSED_ROWS = 65536  # per-device rows budget (B_loc when sharded)
+
+# Test seam: tier-1 has no accelerator, so the sharded plumbing is
+# proven on a forced multi-device CPU host with the expensive kernels
+# stubbed (tests/test_zshardplane_smoke.py flips this in a subprocess).
+# Production CPU backends stay on the host path — interpret-mode Pallas
+# costs minutes per compile.
+ALLOW_CPU_FUSED = False
 
 
 class _Plan:
@@ -36,7 +55,7 @@ class _Plan:
 
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
                  "counted_pos", "n_commits", "pubs_v", "powers_v",
-                 "pending")
+                 "pending", "mesh", "n_dev", "thresh")
 
 
 def _eligible(batch):
@@ -64,15 +83,103 @@ def _eligible(batch):
     return pubs0, powers0
 
 
-def plan_fused(batch, pool=None) -> Optional[_Plan]:
+def shard_positions(vidx, strides, m_shard: int,
+                    n_strides: int) -> np.ndarray:
+    """Row positions for the fused flush layout, single- or multi-chip.
+
+    Validator v of stride s lands at ``d*B_loc + s*m_shard +
+    (v mod m_shard)`` where d = v // m_shard owns the validator's table
+    shard and B_loc = n_strides*m_shard is one device's slice width.
+    With one device m_shard is the whole padded valset and this
+    degenerates to the classic ``s*M + v``. Pure numpy — cfg11's smoke
+    exercises it with no jax in the process."""
+    v = np.asarray(vidx, np.int64)
+    s = np.asarray(strides, np.int64)
+    b_loc = n_strides * m_shard
+    return (v // m_shard) * b_loc + s * m_shard + (v % m_shard)
+
+
+# the plane's flush mesh, memoized per requested device count (mesh
+# identity feeds the step/table memos downstream — a fresh Mesh per
+# flush would defeat them)
+_MESH_MEMO: dict = {}
+
+
+def plane_mesh(devices: int):
+    """Resolve the verify plane's flush mesh: 0 = all local devices,
+    N caps at the first N. Returns None when fewer than 2 devices are
+    usable — single-device dispatch is strictly better then."""
+    import jax
+
+    from cometbft_tpu.parallel import mesh as pm
+
+    devs = jax.devices()
+    n = len(devs) if not devices else min(int(devices), len(devs))
+    if n < 2:
+        return None
+    m = _MESH_MEMO.get(n)
+    if m is None:
+        m = _MESH_MEMO[n] = pm.make_mesh(devs[:n])
+    return m
+
+
+# sub-meshes over a mesh's device prefix, memoized by the exact device
+# tuple (also the seam the pipelined-mesh-halves stretch would use)
+_SUBMESH_MEMO: dict = {}
+
+
+def _sub_mesh(mesh, n_eff: int):
+    from cometbft_tpu.parallel import mesh as pm
+
+    devs = tuple(mesh.devices.flat)[:n_eff]
+    m = _SUBMESH_MEMO.get(devs)
+    if m is None:
+        m = _SUBMESH_MEMO[devs] = pm.make_mesh(list(devs))
+    return m
+
+
+def effective_mesh(mesh, nvals: int):
+    """Clamp a flush mesh to the devices this valset actually fills.
+
+    shard_stride rounds the per-shard slice up to a table_pad bucket,
+    and the coarse buckets can leave trailing shards EMPTY — e.g. 10k
+    validators over 8 devices takes a 4096-slot stride, so devices 3-7
+    would stage, transfer, and verify pure padding on every flush with
+    no correctness benefit. Shrinks the fan-out until every shard
+    holds validators (fixpoint of n_eff = ceil(nvals / m_s)).
+
+    Returns (mesh-or-None, n_dev, m_shard); None means single-device
+    dispatch is strictly better (the whole valset fits one stride).
+    Raises ValueError when the valset exceeds even the full mesh's
+    table budget."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    if mesh is None:
+        return None, 1, ec.shard_stride(nvals, 1)
+    n_eff = int(mesh.devices.size)
+    while True:
+        m_s = ec.shard_stride(nvals, n_eff)
+        need = -(-max(nvals, 1) // m_s)
+        if need >= n_eff:
+            break
+        n_eff = need
+    if n_eff < 2:
+        return None, 1, ec.shard_stride(nvals, 1)
+    if n_eff < mesh.devices.size:
+        mesh = _sub_mesh(mesh, n_eff)
+    return mesh, n_eff, m_s
+
+
+def plan_fused(batch, pool=None, mesh=None) -> Optional[_Plan]:
     """Host-side staging of the fused cached-table dispatch for a
     flush. Returns a _Plan, or None when the flush shape is ineligible
     — the caller then runs the generic grouped path. No device work
     happens here (dispatch_fused/collect_fused do that, under the
-    breaker)."""
+    breaker). `mesh` (a >1-device parallel.mesh Mesh) selects the
+    sharded cross-chip layout; None is the single-device path."""
     import jax
 
-    if jax.default_backend() == "cpu":
+    if jax.default_backend() == "cpu" and not ALLOW_CPU_FUSED:
         return None
     valset = _eligible(batch)
     if valset is None:
@@ -84,15 +191,23 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
     from cometbft_tpu.ops import ed25519_kernel as ek
     from cometbft_tpu.ops.ed25519_pallas import _PB
 
-    M = ec.table_pad(nvals)
+    try:
+        # clamp to the devices this valset fills (empty shards would
+        # verify pure padding); M == table_pad(nvals) when unsharded
+        mesh, n_dev, M = effective_mesh(mesh, nvals)
+    except ValueError:
+        return None  # valset over even the sharded table budget
 
-    # slot assignment: row -> stride*M + vidx, first free stride wins
-    # (a validator's vote and its extension land in different strides)
+    # slot assignment: first free stride wins (a validator's vote and
+    # its extension land in different strides); positions are computed
+    # AFTER the walk — the per-device slice width depends on the final
+    # stride count when the valset is sharded
     pubs: List[bytes] = []
     msgs: List[bytes] = []
     sigs: List[bytes] = []
-    row_pos: List[int] = []
-    counted_pos: List[Optional[int]] = []  # per submission
+    row_v: List[int] = []
+    row_s: List[int] = []
+    counted_ridx: List[Optional[int]] = []  # per submission: row index
     occupied: List[set] = []
     groups: List[object] = []
     gid_of: Dict[int, int] = {}
@@ -104,7 +219,7 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
             gid = gid_of[id(g)] = len(groups)
             groups.append(g)
         sub_gid.append(gid)
-        cpos = None
+        cidx = None
         for k, ((pub, msg, sig), v) in enumerate(zip(sub.rows, sub.vidx)):
             if not (0 <= v < nvals) or pub.data != pubs_v[v] \
                     or len(sig) != 64:
@@ -115,24 +230,29 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
             if s == len(occupied):
                 occupied.append(set())
             occupied[s].add(v)
-            pos = s * M + v
             pubs.append(pub.data)
             msgs.append(msg)
             sigs.append(sig)
-            row_pos.append(pos)
+            row_v.append(v)
+            row_s.append(s)
             if k == 0 and sub.counted:
                 if sub.power != powers_v[v]:
                     return None  # tally rides the table's power column
-                cpos = pos
-        counted_pos.append(cpos)
+                cidx = len(row_v) - 1
+        counted_ridx.append(cidx)
     n = len(pubs)
-    B = len(occupied) * M
-    if n == 0 or B > MAX_FUSED_ROWS:
+    n_strides = len(occupied)
+    # the rows budget is PER DEVICE: each chip runs the kernel on its
+    # B/n_dev slice, so a sharded flush scales the cap with the mesh
+    if n == 0 or n_strides * M > MAX_FUSED_ROWS:
         return None
+    B = n_dev * n_strides * M
 
     n_commits = len(groups)
     pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
-    pos = np.asarray(row_pos, np.int64)
+    pos = shard_positions(row_v, row_s, M, n_strides)
+    counted_pos = [None if ci is None else int(pos[ci])
+                   for ci in counted_ridx]
     # pinned double-buffered staging: the scatter targets and the final
     # packed rows rotate through persistent host buffers per shape (the
     # CALLER's pool — one writer per key; the plane passes its private
@@ -156,7 +276,7 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
     commit_ids = pool.get("fused.cid", (B,), np.int32)
     cur = 0
     for sub, gid, cpos in zip(batch, sub_gid, counted_pos):
-        for p in row_pos[cur:cur + len(sub.rows)]:
+        for p in pos[cur:cur + len(sub.rows)]:
             commit_ids[p] = gid
         cur += len(sub.rows)
         if cpos is not None:
@@ -166,10 +286,17 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
         thresh[gid] = ek.threshold_limbs(max(g.threshold - 1, 0))[0]
 
     pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
-    out = pool.get("fused.rows", ec.packed_rows_shape(B, n_commits),
-                   np.int32)
+    # sharded: thresholds ride as a separate REPLICATED kernel argument
+    # (the in-rows threshold rows would shard into per-device fragments)
+    # so the packed rows carry a zero threshold row; single-device keeps
+    # packing them into the rows as before
+    pack_thresh = None if mesh is not None else thresh
+    out = pool.get(
+        "fused.rows",
+        ec.packed_rows_shape(B, 1 if mesh is not None else n_commits),
+        np.int32)
     plan = _Plan()
-    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, thresh,
+    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, pack_thresh,
                                     out=out)
     plan.pos = pos
     plan.batch = batch
@@ -180,6 +307,9 @@ def plan_fused(batch, pool=None) -> Optional[_Plan]:
     plan.pubs_v = pubs_v
     plan.powers_v = powers_v
     plan.pending = None
+    plan.mesh = mesh
+    plan.n_dev = n_dev
+    plan.thresh = thresh
     return plan
 
 
@@ -197,16 +327,39 @@ def dispatch_fused(plan: _Plan) -> None:
     on dispatch-time device faults (the caller's breaker handles
     those). The rows buffer is dead once the kernel has read it, and
     the staging pool rotation guarantees the host copy isn't reused
-    until this flight lands."""
+    until this flight lands.
+
+    With a mesh plan, the rows stage straight to the batch
+    NamedSharding (one device_put, no host resharding inside the
+    jitted step), the table comes from the per-shard device-resident
+    cache, and the tally psums across the mesh — the quorum bit is
+    still a kernel output."""
     from cometbft_tpu.ops import ed25519_cached as ec
 
-    # pubs_v/powers_v are the QuorumGroup's immutable tuples, so the
-    # content-key digest is identity-memoized (no per-flush O(valset)
-    # hashing) and a steady-state flush never re-uploads the valset
-    table = ec.table_for_pubs(plan.pubs_v, plan.powers_v)
-    plan.pending = ec.verify_tally_rows_cached(
-        plan.rows, table, plan.n_commits
-    )
+    if plan.mesh is None:
+        # pubs_v/powers_v are the QuorumGroup's immutable tuples, so the
+        # content-key digest is identity-memoized (no per-flush O(valset)
+        # hashing) and a steady-state flush never re-uploads the valset
+        table = ec.table_for_pubs(plan.pubs_v, plan.powers_v)
+        plan.pending = ec.verify_tally_rows_cached(
+            plan.rows, table, plan.n_commits
+        )
+        return
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cometbft_tpu.parallel import mesh as pm
+
+    table = ec.sharded_table_for_pubs(plan.pubs_v, plan.powers_v,
+                                      plan.mesh)
+    step = pm.sharded_fused_verify(plan.mesh, plan.n_commits)
+    axis = plan.mesh.axis_names[0]
+    rows_d = jax.device_put(
+        plan.rows, NamedSharding(plan.mesh, P(None, axis)))
+    thresh_d = jax.device_put(
+        plan.thresh, NamedSharding(plan.mesh, P(None, None)))
+    plan.pending = step(rows_d, table.tab, table.ok, table.power5,
+                        ec.base60_repl(plan.mesh), thresh_d)
 
 
 def collect_fused(plan: _Plan) -> Tuple[List[bool], Dict[object, int]]:

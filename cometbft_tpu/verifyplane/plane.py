@@ -29,7 +29,12 @@ Knobs ([verify_plane] config): window_ms bounds added latency,
 max_batch bounds device batch size (bucket padding reuses the compiled
 kernel shapes from ops/), max_queue bounds memory and provides
 backpressure — a full queue blocks submitters (or raises PlaneQueueFull
-for non-blocking callers, who then verify inline on the host).
+for non-blocking callers, who then verify inline on the host). The
+mesh knobs (mesh / mesh_devices / mesh_min_rows) shard eligible fused
+flushes across the local device mesh: per-shard device-resident valset
+tables, tally psum-reduced on device, quorum still a kernel output —
+one cross-chip pass for commits past a single chip's valset ceiling
+(fused.py "Multichip").
 
 QoS lanes (overload resilience): every submission rides one of three
 priority classes.  CONSENSUS (the default: gossiped votes, commits,
@@ -122,6 +127,7 @@ LEDGER_CAPACITY = 256
 # flush dispatch paths (interned module constants — the ledger must not
 # build strings per flush)
 PATH_FUSED = "fused"                # cached-table device pass, airborne
+PATH_FUSED_SHARDED = "fused_sharded"  # cross-chip mesh pass, airborne
 PATH_GROUPED = "grouped"            # generic device pass (sync)
 PATH_HOST = "host"                  # no accelerator: inline host verify
 PATH_FAILPOINT = "failpoint_host"   # dispatch failpoint degraded flush
@@ -136,10 +142,10 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 # the ring slot" is literal, not approximate.
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
  _L_COLLECT, _L_SETTLE, _L_OVER, _L_PATH, _L_BRK, _L_SMISS,
- _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED) = range(18)
+ _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV) = range(19)
 # internal slots past the FIELDS window: two ns stamps + the clock
 # generation they were taken under (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN = 18, 19, 20
+_L_T0NS, _L_TPACKED, _L_GEN = 19, 20, 21
 
 
 class FlushLedger:
@@ -152,14 +158,17 @@ class FlushLedger:
     breaker state observed at stage time, staging-pool misses charged
     to this flush, the queue depth left behind, the per-lane row split
     (c_rows CONSENSUS / g_rows GATEWAY / b_rows BULK), and how many
-    sheddable-lane submissions were shed at this drain. Written by the
-    dispatcher even when tracing is off; read by /dump_flushes, the
-    scrape-time /metrics percentiles, and simnet replay blobs."""
+    sheddable-lane submissions were shed at this drain, and the device
+    fan-out n_dev (1 = single-device/host pass, >1 = the cross-chip
+    sharded mesh pass — so /dump_flushes can attribute multichip
+    flushes). Written by the dispatcher even when tracing is off; read
+    by /dump_flushes, the scrape-time /metrics percentiles, and simnet
+    replay blobs."""
 
     FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
               "flight_ms", "collect_ms", "settle_ms", "overlapped",
               "path", "breaker", "staging_miss", "depth",
-              "c_rows", "g_rows", "b_rows", "shed")
+              "c_rows", "g_rows", "b_rows", "shed", "n_dev")
 
     __slots__ = ("_ring",)
 
@@ -190,6 +199,7 @@ class FlushLedger:
                 f"queued={r[_L_QUEUED]}ms pack={r[_L_PACK]}ms "
                 f"flight={r[_L_FLIGHT]}ms collect={r[_L_COLLECT]}ms "
                 f"settle={r[_L_SETTLE]}ms"
+                + (f" x{r[_L_NDEV]}dev" if r[_L_NDEV] > 1 else "")
                 + (" overlapped" if r[_L_OVER] else "")
             )
         return out
@@ -233,6 +243,15 @@ class FlushLedger:
                       LANE_GATEWAY: int(sum(cols["g_rows"])),
                       LANE_BULK: int(sum(cols["b_rows"]))},
             "shed": int(sum(cols["shed"])),
+            # cross-chip attribution: flushes/rows that rode the
+            # sharded mesh pass, and the widest fan-out seen
+            "shard": {
+                "flushes": sum(1 for d in cols["n_dev"] if d > 1),
+                "rows": int(sum(r for r, d in zip(cols["rows"],
+                                                  cols["n_dev"])
+                                if d > 1)),
+                "n_dev_max": int(max(cols["n_dev"], default=0)),
+            },
         }
 DEFAULT_RESULT_TIMEOUT = 30.0
 # stop()-time leftover drain budget: rows host-verified synchronously
@@ -416,7 +435,9 @@ class VerifyPlane:
                  bulk_deadline_ms: float = 250.0,
                  gateway_window_ms: Optional[float] = None,
                  gateway_max_queue: Optional[int] = None,
-                 gateway_deadline_ms: float = 500.0):
+                 gateway_deadline_ms: float = 500.0,
+                 mesh_devices: Optional[int] = None,
+                 mesh_min_rows: int = 256):
         from cometbft_tpu.crypto import batch as cbatch
         from cometbft_tpu.libs.staging import StagingPool
 
@@ -487,6 +508,19 @@ class VerifyPlane:
         self._shed_lock = threading.Lock()
         self.lane_waits = {lane: deque(maxlen=LANE_WAIT_WINDOW)
                            for lane in LANES}
+        # multichip sharded dispatch ([verify_plane] mesh knobs):
+        # mesh_devices None = single-device; 0 = shard fused flushes
+        # over ALL local devices; N = cap at N. mesh_min_rows keeps
+        # tiny flushes on one chip — a cross-chip pass only pays off
+        # once the per-device slice is worth its psum.
+        self._mesh_devices = (None if mesh_devices is None
+                              else max(0, int(mesh_devices)))
+        self.mesh_min_rows = max(0, int(mesh_min_rows))
+        self._mesh = None          # resolved lazily, once
+        self._mesh_resolved = False
+        self.shard_flushes = 0     # flushes dispatched cross-chip
+        self.shard_rows = 0        # rows those flushes carried
+        self.mesh_ndev = 0         # resolved fan-out (0 = single-dev)
         # always-on flush ledger (bounded ring; survives stop() — it is
         # read-only history, never cleared by the lifecycle)
         self.ledger = FlushLedger()
@@ -559,7 +593,7 @@ class VerifyPlane:
                 round((t1 - t0) / 1e6, 3),
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
                 False, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
-                c_rows, g_rows, len(rows) - c_rows - g_rows, 0,
+                c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -830,7 +864,7 @@ class VerifyPlane:
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, False, PATH_SHED_ONLY,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed),
+                        len(shed), 0,
                     ])
             flight = self._stage(batch, depth, shed_n=len(shed)) \
                 if batch else None
@@ -953,8 +987,8 @@ class VerifyPlane:
         led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, False,
                PATH_HOST, self._breaker.state, 0, depth,
-               c_rows, g_rows, rows - c_rows - g_rows, shed_n, t0, t0,
-               gen]
+               c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, t0,
+               t0, gen]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path
@@ -969,6 +1003,28 @@ class VerifyPlane:
         led[_L_PACK] = round((t1 - t0) / 1e6, 3)
         led[_L_TPACKED] = t1
         return batch, finish, airborne, fid, led
+
+    def _flush_mesh(self, rows: int):
+        """The mesh a fused flush of `rows` rows should shard over, or
+        None for single-device dispatch. Resolution is lazy and cached
+        (mesh identity feeds every downstream memo); flushes under
+        mesh_min_rows stay on one chip — the psum isn't free and tiny
+        flushes fit a single device's lanes anyway."""
+        if self._mesh_devices is None or rows < self.mesh_min_rows:
+            return None
+        if not self._mesh_resolved:
+            from cometbft_tpu.verifyplane import fused as fz
+
+            try:
+                self._mesh = fz.plane_mesh(self._mesh_devices)
+            except Exception:  # noqa: BLE001 - no backend: stay single
+                self._mesh = None
+            self._mesh_resolved = True
+            self.mesh_ndev = (0 if self._mesh is None
+                              else int(self._mesh.devices.size))
+            if self.metrics is not None:
+                self.metrics.plane_shard_ndev.set(float(self.mesh_ndev))
+        return self._mesh
 
     def _stage_inner(self, batch: List[_Submission], fid: int, led):
         """The breaker's allow() — which consumes the single half-open
@@ -996,7 +1052,8 @@ class VerifyPlane:
             from cometbft_tpu.verifyplane import fused as fz
 
             try:
-                plan = fz.plan_fused(batch, pool=self._staging)
+                plan = fz.plan_fused(batch, pool=self._staging,
+                                     mesh=self._flush_mesh(len(rows)))
             except Exception:  # noqa: BLE001 - staging bug, not device
                 _log.exception("fused flush staging failed; grouped path")
                 plan = None
@@ -1014,7 +1071,11 @@ class VerifyPlane:
                                      cat="verifyplane", rows=len(rows))
                 self._observe_pack(time.perf_counter() - t0,
                                    fz.plan_h2d_bytes(plan))
-                led[_L_PATH] = PATH_FUSED
+                if plan.mesh is not None:
+                    led[_L_PATH] = PATH_FUSED_SHARDED
+                    led[_L_NDEV] = plan.n_dev
+                else:
+                    led[_L_PATH] = PATH_FUSED
                 led[_L_SMISS] = self._staging.misses - miss0
 
                 def finish():
@@ -1027,11 +1088,26 @@ class VerifyPlane:
                             "host fallback for this flush"
                         )
                         led[_L_PATH] = PATH_FUSED_FALLBACK
+                        # the verdicts below come from the HOST: a
+                        # sharded flight that faulted must not keep
+                        # claiming cross-chip fan-out (ledger n_dev
+                        # and the shard counters/metrics would
+                        # disagree with host_fallback — the PR-7 shed
+                        # column lesson)
+                        led[_L_NDEV] = 1
                         return _host_verdicts(rows), None
                     finally:
                         if prof is not None:
                             prof()
                     self._breaker.record_success()
+                    if plan.mesh is not None:
+                        # counted on COLLECT success: only completed
+                        # cross-chip passes are attributed sharded
+                        self.shard_flushes += 1
+                        self.shard_rows += len(rows)
+                        if self.metrics is not None:
+                            self.metrics.plane_shard_flushes.inc()
+                            self.metrics.plane_shard_rows.inc(len(rows))
                     return out
 
                 return batch, finish, True
@@ -1140,6 +1216,9 @@ class VerifyPlane:
             "h2d_bytes": self.h2d_bytes,
             "overlapped": self.overlapped,
             "flushes_logged": len(self.ledger),
+            "mesh_ndev": self.mesh_ndev,
+            "shard_flushes": self.shard_flushes,
+            "shard_rows": self.shard_rows,
         }
 
     def lane_depths(self) -> dict:

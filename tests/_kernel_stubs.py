@@ -1,0 +1,38 @@
+"""Shared shape-faithful stub of ops.ed25519_cached's fused kernel.
+
+The real ``_verify_tally_cached`` is a Pallas program (minutes of
+interpret compile on CPU); this stub keeps its CONTRACT — validity =
+precheck flag & ok[row mod M] with M derived from the table shape,
+voting power tiled by the same local-index map, counted/commit-id flag
+decoding, tally via the real ``tally_core`` — so sharding tests
+exercise the layout/psum/memo plumbing against the exact local-index
+semantics the kernel implements. The quorum output is zeros: every
+sharded caller discards the in-rows quorum and recomputes it from
+replicated thresholds.
+
+One copy, used by tests/test_mesh.py (in-process 8-device mesh) and
+tests/_shardplane_prog.py (forced 4-device subprocess), so the
+contract cannot drift between them.
+"""
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import ed25519_cached as ec
+from cometbft_tpu.ops import ed25519_kernel as ek
+
+
+def fake_verify_tally_cached(rows, tab, ok, power5, base, n_commits):
+    rows = jnp.asarray(rows)
+    B = rows.shape[1]
+    M = tab.shape[0] // ec.ENT_BLOCK * 128
+    vidx = jax.lax.broadcasted_iota(jnp.int32, (B,), 0) % M
+    valid = ((rows[ec.V_FLAGS] >> 1) & 1 != 0) \
+        & jnp.take(ok, vidx, axis=0)
+    pw = jnp.tile(power5, (-(-B // M), 1))[:B]
+    counted = (rows[ec.V_FLAGS] >> 2) & 1 != 0
+    commit_ids = rows[ec.V_FLAGS] >> 3
+    tally = ek.tally_core(valid, pw, counted, commit_ids, n_commits)
+    return valid, tally, jnp.zeros((n_commits,), bool)
+
+
+fake_verify_tally_cached.__wrapped__ = fake_verify_tally_cached

@@ -1,0 +1,290 @@
+"""Subprocess body for tests/test_zshardplane_smoke.py.
+
+Runs under a FORCED 4-virtual-device CPU mesh (the parent sets
+XLA_FLAGS=--xla_force_host_platform_device_count=4, JAX_PLATFORMS=cpu)
+and proves the verify plane's cross-chip sharded fused path without a
+TPU: the two expensive device programs are stubbed — the Pallas cached
+kernel by a precheck&ok plumbing fake and the XLA table build by a
+shape-faithful fake — so what executes is exactly the machinery ISSUE
+10 added: plan_fused's sharded scatter layout, per-shard table
+assembly + (valset, mesh) memoization, the sharded_fused_verify step
+(psum tally, replicated thresholds), ledger n_dev attribution, and the
+breaker/PlaneOverloaded semantics around a faulting sharded dispatch.
+
+Asserts, then prints one JSON summary line the parent test parses:
+  * sharded verdicts, per-group tallies, and quorum bits are
+    BIT-IDENTICAL to the single-device oracle (same stubs, one chip);
+  * the second sharded flush HITs the mesh step memo and the sharded
+    table memo (no steady-state re-trace or re-upload);
+  * a faulting sharded dispatch degrades that flush with correct
+    verdicts, trips the breaker, and BULK-lane PlaneOverloaded
+    shedding still carries its retry hint.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+assert len(jax.devices()) == 4, jax.devices()
+assert jax.default_backend() == "cpu"
+
+from cometbft_tpu.crypto import batch as cbatch  # noqa: E402
+from cometbft_tpu.crypto.keys import PrivKey  # noqa: E402
+from cometbft_tpu.ops import ed25519_cached as ec  # noqa: E402
+from cometbft_tpu.parallel import mesh as pm  # noqa: E402
+from cometbft_tpu.verifyplane import fused as fz  # noqa: E402
+from cometbft_tpu.verifyplane import (  # noqa: E402
+    PlaneOverloaded,
+    QuorumGroup,
+    VerifyPlane,
+)
+
+# ---- stubs: the minutes-of-compile device programs, not the plumbing ----
+
+from _kernel_stubs import fake_verify_tally_cached  # noqa: E402
+
+fz.ALLOW_CPU_FUSED = True
+ec._BASE60_F32 = np.zeros((32 * 256, ec.ROWS_PER_ENT), np.float32)
+ec._verify_tally_cached = fake_verify_tally_cached
+
+real_pack_pubs = ec._pack_pub_arrays
+
+
+def fake_build_table(pub_bytes, powers=None):
+    padded = ec.table_pad(len(pub_bytes))
+    ok = np.zeros((padded,), np.bool_)
+    ok[: len(pub_bytes)] = [len(p) == 32 for p in pub_bytes]
+    return ec.ValsetTable(
+        jnp.zeros((padded // 128 * ec.ENT_BLOCK, 128), jnp.int16),
+        jnp.asarray(ok), ec._power_dev(powers, padded), padded,
+        ec._pubs_host(pub_bytes, padded),
+        ec._powers_host(powers, padded))
+
+
+ec.build_table = fake_build_table
+
+# ---- fixture: a 300-validator valset spanning 2 of 4 table shards ----
+
+# shard stride 256 (table_pad bucket) -> only 2 shards hold validators
+# (the second one partially), so effective_mesh must CLAMP the flush to
+# a 2-device sub-mesh — empty shards would stage + verify pure padding
+# every flush
+NVALS = 300
+EXPECT_NDEV = 2
+
+mesh4 = fz.plane_mesh(0)
+assert mesh4 is not None and mesh4.devices.size == 4
+m_eff, n_eff, m_s_eff = fz.effective_mesh(mesh4, NVALS)
+assert (n_eff, m_s_eff) == (EXPECT_NDEV, 256), (n_eff, m_s_eff)
+assert m_eff.devices.size == EXPECT_NDEV
+# a valset filling every stride keeps the full fan-out...
+assert fz.effective_mesh(mesh4, 1024)[1] == 4
+# ...and one that fits a single stride is single-device business
+assert fz.effective_mesh(mesh4, 100)[0] is None
+privs = [PrivKey.generate((4200 + i).to_bytes(4, "big") + b"\x77" * 28)
+         for i in range(NVALS)]
+pubs_t = tuple(p.pub_key().data for p in privs)
+powers_t = tuple((i % 9 + 1) * 100 for i in range(NVALS))
+submitters = list(range(0, NVALS, 7))  # spread across the shards
+
+BAD_SIG = b"\x5a" * 32 + b"\xff" * 32  # S >= L: precheck AND ref reject
+
+
+def make_batch(groups):
+    """(rows, vidx, group, power, expected_verdicts) per submission:
+    vote + extension rows; every 5th vote forged, every 11th extension
+    forged (valid vote + forged ext => power must NOT stand)."""
+    subs = []
+    for j, v in enumerate(submitters):
+        pub = privs[v].pub_key()
+        m1 = b"vote-%d" % v
+        m2 = b"ext-%d" % v
+        s1 = BAD_SIG if j % 5 == 0 else privs[v].sign(m1)
+        s2 = BAD_SIG if j % 11 == 3 else privs[v].sign(m2)
+        exp = (j % 5 != 0, j % 11 != 3)
+        subs.append(([(pub, m1, s1), (pub, m2, s2)], (v, v),
+                     groups[v % 2], powers_t[v], exp))
+    return subs
+
+
+def drive(plane, groups):
+    futs = [plane.submit_many(rows, power=pw, group=g, counted=True,
+                              vidx=vidx)
+            for rows, vidx, g, pw, _ in make_batch(groups)]
+    return [f.result(30.0) for f in futs]
+
+
+def expected():
+    exp_verdicts = [e for *_, e in make_batch([None, None])]
+    tallies = [0, 0]
+    for (rows, vidx, _g, pw, e) in make_batch([None, None]):
+        if all(e):
+            tallies[vidx[0] % 2] += pw
+    return exp_verdicts, tallies
+
+
+def new_groups(thr):
+    return [QuorumGroup(thr[c], valset_pubs=pubs_t,
+                        valset_powers=powers_t) for c in range(2)]
+
+
+exp_verdicts, exp_tallies = expected()
+# one group crosses its threshold, the other misses it
+THR = [exp_tallies[0], exp_tallies[1] + 1]
+
+# ---- phase A: single-device oracle --------------------------------------
+
+plane_s = VerifyPlane(window_ms=40.0, max_batch=4096, use_device=True)
+plane_s.start()
+groups_s = new_groups(THR)
+verd_s = drive(plane_s, groups_s)
+plane_s.stop()
+assert verd_s == exp_verdicts, (verd_s, exp_verdicts)
+assert [g.tally for g in groups_s] == exp_tallies
+assert [g.quorum_reached for g in groups_s] == [True, False]
+led_s = plane_s.dump_flushes()["flushes"]
+assert any(r["path"] == "fused" and r["n_dev"] == 1 for r in led_s), led_s
+
+# ---- phase B: sharded across the 4-device mesh, bit-identical -----------
+
+plane_m = VerifyPlane(window_ms=40.0, max_batch=4096, use_device=True,
+                      mesh_devices=0, mesh_min_rows=1)
+plane_m.start()
+groups_m = new_groups(THR)
+verd_m = drive(plane_m, groups_m)
+assert verd_m == verd_s, "sharded verdicts diverged from single-device"
+assert [g.tally for g in groups_m] == [g.tally for g in groups_s]
+assert [g.quorum_reached for g in groups_m] == \
+    [g.quorum_reached for g in groups_s]
+
+# ---- phase C: steady state hits every memo (no re-trace, no re-upload) --
+
+mesh_before = pm.cache_stats()
+tbl_before = ec.table_cache_stats()
+groups_m2 = new_groups(THR)
+verd_m2 = drive(plane_m, groups_m2)
+assert verd_m2 == verd_s
+mesh_after = pm.cache_stats()
+tbl_after = ec.table_cache_stats()
+assert mesh_after["hits"] > mesh_before["hits"]
+assert mesh_after["misses"] == mesh_before["misses"], \
+    "second sharded flush re-traced a mesh step"
+assert tbl_after["shard_hits"] > tbl_before["shard_hits"]
+assert tbl_after["shard_misses"] == tbl_before["shard_misses"], \
+    "second sharded flush rebuilt the sharded table"
+
+recs = plane_m.dump_flushes()["flushes"]
+shard_recs = [r for r in recs if r["path"] == "fused_sharded"]
+assert shard_recs and all(r["n_dev"] == EXPECT_NDEV
+                          for r in shard_recs), recs
+summary = plane_m.dump_flushes()["summary"]
+assert summary["shard"]["flushes"] >= 2
+assert summary["shard"]["n_dev_max"] == EXPECT_NDEV
+stats = plane_m.stats()
+# mesh_ndev reports the RESOLVED configured mesh; the ledger column
+# reports the clamped per-flush fan-out
+assert stats["mesh_ndev"] == 4 and stats["shard_flushes"] >= 2
+plane_m.stop()
+
+# ---- phase C2: an IN-FLIGHT sharded fault must not claim cross-chip -----
+# (JAX async dispatch surfaces most device faults at collect, not
+# dispatch: the record must repair to n_dev=1 host attribution and the
+# shard counters must only ever count COMPLETED cross-chip passes)
+
+real_collect = fz.collect_fused
+fault = {"armed": True}
+
+
+def faulty_collect(plan):
+    if fault["armed"]:
+        fault["armed"] = False
+        raise RuntimeError("injected in-flight device fault")
+    return real_collect(plan)
+
+
+fz.collect_fused = faulty_collect
+plane_c = VerifyPlane(
+    window_ms=40.0, max_batch=4096, use_device=True, mesh_devices=0,
+    mesh_min_rows=1,
+    breaker=cbatch.CircuitBreaker(failure_threshold=3, cooldown=60.0))
+plane_c.start()
+groups_c = new_groups(THR)
+verd_c = drive(plane_c, groups_c)
+plane_c.stop()
+fz.collect_fused = real_collect
+assert verd_c == exp_verdicts, "in-flight fault changed verdicts"
+assert [g.tally for g in groups_c] == exp_tallies
+recs_c = plane_c.dump_flushes()["flushes"]
+fallbacks = [r for r in recs_c if r["path"] == "fused_host_fallback"]
+assert fallbacks and all(r["n_dev"] == 1 for r in fallbacks), recs_c
+completed = [r for r in recs_c if r["path"] == "fused_sharded"]
+assert plane_c.stats()["shard_flushes"] == len(completed), recs_c
+
+# ---- phase D: a faulting sharded dispatch degrades, breaker + sheds -----
+
+
+def host_direct(pubs, msgs, sigs, kernels=None, breaker=None):
+    out = []
+    for p, m, s in zip(pubs, msgs, sigs):
+        try:
+            out.append(bool(p.verify_signature(m, s)))
+        except ValueError:
+            out.append(False)
+    return np.asarray(out, np.bool_)
+
+
+cbatch.verify_batch_direct = host_direct
+real_dispatch = fz.dispatch_fused
+
+
+def faulting_dispatch(plan):
+    raise RuntimeError("injected sharded device fault")
+
+
+fz.dispatch_fused = faulting_dispatch
+brk = cbatch.CircuitBreaker(failure_threshold=1, cooldown=60.0)
+plane_f = VerifyPlane(window_ms=40.0, max_batch=4096, use_device=True,
+                      mesh_devices=0, mesh_min_rows=1, breaker=brk,
+                      bulk_max_queue=2, bulk_window_ms=10_000.0)
+plane_f.start()
+groups_f = new_groups(THR)
+verd_f = drive(plane_f, groups_f)
+# verdicts still correct (host fallback), tallies still land host-side
+assert verd_f == exp_verdicts
+assert [g.tally for g in groups_f] == exp_tallies
+assert brk.state == "open", "sharded dispatch fault must trip the breaker"
+recs_f = plane_f.dump_flushes()["flushes"]
+assert any(r["path"] == "grouped" for r in recs_f), recs_f
+assert not any(r["path"] == "fused_sharded" for r in recs_f)
+
+# BULK shedding semantics are unchanged with a mesh configured: the
+# lane bound still answers with an explicit retry-hinted verdict
+# (bulk_window is 10s, so the queued row cannot drain underneath us)
+p0 = privs[0]
+row = (p0.pub_key(), b"bulk-0", p0.sign(b"bulk-0"))
+plane_f.submit_many([row], lane="bulk")
+try:
+    plane_f.submit_many([row, row, row], lane="bulk", block=False)
+    raise AssertionError("over-bound BULK submit was not shed")
+except PlaneOverloaded as e:
+    assert e.retry_after_ms > 0
+assert plane_f.sheds["bulk"] >= 1
+plane_f.stop()
+fz.dispatch_fused = real_dispatch
+
+print(json.dumps({
+    "ok": True,
+    "devices": len(jax.devices()),
+    "verdicts": len(verd_m),
+    "sharded_flushes": summary["shard"]["flushes"],
+    "n_dev_max": summary["shard"]["n_dev_max"],
+    "mesh_hits_gained": mesh_after["hits"] - mesh_before["hits"],
+    "shard_table_hits_gained":
+        tbl_after["shard_hits"] - tbl_before["shard_hits"],
+}))

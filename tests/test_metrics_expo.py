@@ -145,6 +145,9 @@ def test_full_nodemetrics_promtext_roundtrip():
                  "cometbft_consensus_txs_total",
                  "cometbft_consensus_step_duration_seconds",
                  "cometbft_verifyplane_batch_rows",
+                 "cometbft_verifyplane_shard_flushes_total",
+                 "cometbft_verifyplane_shard_rows_total",
+                 "cometbft_verifyplane_shard_devices",
                  "cometbft_crypto_valset_table_cache_total",
                  "cometbft_parallel_mesh_step_cache_total",
                  "cometbft_crypto_staging_pool_total",

@@ -455,6 +455,69 @@ def test_config_section_and_validation(tmp_path):
         cfg.validate_basic()
 
 
+def test_config_mesh_knobs_roundtrip_and_validation(tmp_path):
+    """ISSUE 10: the [verify_plane] mesh knobs load/save/validate and
+    reach the plane — a host plane with no mesh configured stays
+    single-device (mesh_ndev 0, every ledger record n_dev 1)."""
+    from cometbft_tpu.config.config import (
+        Config,
+        ConfigError,
+        load_config,
+        save_config,
+    )
+
+    cfg = Config()
+    cfg.verify_plane.enable = True
+    cfg.verify_plane.mesh = True
+    cfg.verify_plane.mesh_devices = 4
+    cfg.verify_plane.mesh_min_rows = 32
+    cfg.validate_basic()
+    path = str(tmp_path / "config.toml")
+    save_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.verify_plane.mesh is True
+    assert loaded.verify_plane.mesh_devices == 4
+    assert loaded.verify_plane.mesh_min_rows == 32
+    p = loaded.verify_plane.build()
+    try:
+        assert p._mesh_devices == 4
+        assert p.mesh_min_rows == 32
+    finally:
+        p.stop()
+    # mesh off: the knob must not reach the plane
+    loaded.verify_plane.mesh = False
+    p2 = loaded.verify_plane.build()
+    try:
+        assert p2._mesh_devices is None
+    finally:
+        p2.stop()
+    cfg.verify_plane.mesh_devices = 1
+    with pytest.raises(ConfigError, match="mesh_devices"):
+        cfg.validate_basic()
+    cfg.verify_plane.mesh_devices = 0
+    cfg.verify_plane.mesh_min_rows = -1
+    with pytest.raises(ConfigError, match="mesh_min_rows"):
+        cfg.validate_basic()
+
+
+def test_ledger_n_dev_column_on_host_flushes(plane):
+    """Every flush record carries the device fan-out column; host/
+    single-device flushes stamp n_dev=1 and the summary's shard block
+    stays empty — the surfaces /dump_flushes uses to attribute
+    cross-chip flushes (the sharded stamping itself is proven in
+    tests/test_zshardplane_smoke.py on a forced 4-device host)."""
+    pubs, msgs, sigs, _ = make_rows(5)
+    plane.submit_and_wait(pubs, msgs, sigs)
+    dump = plane.dump_flushes()
+    recs = dump["flushes"]
+    assert recs and all(r["n_dev"] == 1 for r in recs)
+    shard = dump["summary"]["shard"]
+    assert shard == {"flushes": 0, "rows": 0, "n_dev_max": 1}
+    st = plane.stats()
+    assert st["mesh_ndev"] == 0
+    assert st["shard_flushes"] == 0 and st["shard_rows"] == 0
+
+
 def test_plane_metrics_exposed(plane):
     from cometbft_tpu.libs.metrics import NodeMetrics
 

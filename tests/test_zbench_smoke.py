@@ -36,13 +36,13 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 4  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 5  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
-    assert sorted(results) == ["cfg10_smoke", "cfg2_smoke",
-                               "cfg4_smoke", "cfg6_smoke"]
+    assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
+                               "cfg2_smoke", "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -53,6 +53,10 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     # the cfg4 miniature carries the disabled-path hook-cost proof row
     dfp = results["cfg4_smoke"]["extra"]["disabled_flush_path"]
     assert dfp["ledger_bookkeeping_us_per_flush"] > 0
+    # the cfg11 miniature proved the sharded layout + ledger n_dev
+    sh = results["cfg11_smoke"]["extra"]
+    assert sh["ledger_n_dev"] == 1
+    assert sh["shard_summary"]["flushes"] == 0
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:
