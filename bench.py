@@ -813,8 +813,9 @@ def disabled_flush_bookkeeping_us(k: int = 20_000) -> dict:
         t0 = tracing.monotonic_ns()
         gen = tracing.clock_gen()
         rec = [i, round(t0 / 1e6, 3), 64, 4,
-               round((t0 - t0) / 1e6, 3), 0.0, 0.0, 0.0, 0.0, False,
-               PATH_HOST, "closed", 0, 0, 64, 0, 0, 0, 1, t0, t0, gen]
+               round((t0 - t0) / 1e6, 3), 0.0, 0.0, 0.0, 0.0, 0,
+               PATH_HOST, "closed", 0, 0, 64, 0, 0, 0, 1, 1, 0,
+               t0, t0, gen]
         t1 = tracing.monotonic_ns()
         rec[5] = round((t1 - t0) / 1e6, 3)
         t2 = tracing.monotonic_ns()
@@ -1386,6 +1387,109 @@ def cfg11_sharded_tally(n_vals=10_000, target_big=100_000):
     }
 
 
+def cfg12_pipelined(n_vals=4096, n_flushes=24):
+    """#12: pipelined mesh halves (ISSUE 11) — deck-on vs deck-off
+    sustained flush throughput through the REAL plane dispatcher.
+
+    Streams fused valset-backed flushes (one submission = one flush,
+    max_batch pinned to the flush size) through three plane arms:
+    pipeline_flights=1 (the PR-9 single-flight baseline),
+    pipeline_flights=2 at half-mesh size (alternating flushes fly
+    DISJOINT halves; pack+dispatch of k+1 overlaps flight k), and
+    pipeline_flights=2 with half_mesh_rows=1 (every flush forced to
+    the full mesh — the drain-the-deck policy arm, bounding what the
+    halves buy). Verdicts must match across arms; on a >=4-device
+    host the deck arm's ledger must show genuinely concurrent flights
+    (deck airborne_max >= 1). Degrades honestly on hosts without
+    halves (deck == baseline; the row still records)."""
+    import jax
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import QuorumGroup, VerifyPlane
+    from cometbft_tpu.verifyplane import fused as fz
+
+    n_local = len(jax.devices())
+    host_only = jax.default_backend() == "cpu" and not fz.ALLOW_CPU_FUSED
+    if host_only:
+        # no device: the fused/deck path never engages — keep the row
+        # alive at a tiny host-path shape instead of minutes of
+        # pure-Python ed25519
+        n_vals, n_flushes = 32, 4
+    keys = [PrivKey.generate((9400 + i).to_bytes(4, "big") + b"\x55" * 28)
+            for i in range(n_vals)]
+    pubs_t = tuple(k.pub_key().data for k in keys)
+    powers_t = tuple(100 for _ in range(n_vals))
+    msgs = [b"cfg12-%d" % i for i in range(n_vals)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    rows_all = [(k.pub_key(), m, s) for k, m, s in zip(keys, msgs, sigs)]
+    vidx_all = tuple(range(n_vals))
+
+    def run(flights, half_rows=0, timed_flushes=n_flushes):
+        plane = VerifyPlane(
+            window_ms=0.5, max_batch=n_vals,
+            max_queue=n_vals * (timed_flushes + 2),
+            use_device=None if host_only else True,
+            mesh_devices=0, mesh_min_rows=1, pipeline_flights=flights,
+            half_mesh_rows=half_rows)
+        plane.start()
+        try:
+            def burst(k):
+                groups = [QuorumGroup(10 ** 15, valset_pubs=pubs_t,
+                                      valset_powers=powers_t)
+                          for _ in range(k)]
+                futs = [plane.submit_many(rows_all, group=g,
+                                          vidx=vidx_all)
+                        for g in groups]
+                return [f.result(300.0) for f in futs]
+
+            burst(2)  # warm: compile the mesh programs off the clock
+            t = _now_ms()
+            verd = burst(timed_flushes)
+            wall = _now_ms() - t
+        finally:
+            plane.stop()
+        summary = plane.dump_flushes()["summary"]
+        return wall, verd, summary, plane.stats()
+
+    wall_1, verd_1, sum_1, st_1 = run(1)
+    wall_deck, verd_deck, sum_deck, st_deck = run(2)
+    wall_full, verd_full, sum_full, _ = run(2, half_rows=1)
+    assert verd_deck == verd_1, "deck arm verdicts diverged"
+    assert verd_full == verd_1, "full-mesh arm verdicts diverged"
+    halves = st_deck["halves"]
+    if halves == 2:
+        assert sum_deck["deck"]["airborne_max"] >= 1, (
+            "deck never flew two flights on a half-capable mesh",
+            sum_deck)
+    fps = n_flushes / (wall_deck / 1000) if wall_deck else 0.0
+    return {
+        "metric": "cfg12 pipelined mesh halves sustained flushes",
+        "value": round(n_flushes * n_vals / (wall_deck / 1000))
+        if wall_deck else None,
+        "unit": "sigs/sec",
+        "vs_baseline": round(wall_1 / wall_deck, 2) if wall_deck else None,
+        "extra": {
+            "devices": n_local,
+            "halves": halves,
+            "host_only": host_only,
+            "flushes": n_flushes,
+            "rows_per_flush": n_vals,
+            "flushes_per_sec_deck": round(fps, 2),
+            "wall_single_ms": round(wall_1, 1),
+            "wall_deck_ms": round(wall_deck, 1),
+            "wall_full_mesh_ms": round(wall_full, 1),
+            "deck_airborne_max": sum_deck["deck"]["airborne_max"],
+            "deck_overlapped_flushes":
+                sum_deck["deck"]["overlapped_flushes"],
+            "deck_peak": st_deck["deck_peak"],
+            "single_airborne_max": sum_1["deck"]["airborne_max"],
+            "full_mesh_airborne_max": sum_full["deck"]["airborne_max"],
+            "note": "deck-on vs deck-off through the real dispatcher; "
+                    "full-mesh arm exercises the drain-first policy",
+        },
+    }
+
+
 def headline_10k():
     """The driver metric: 10k-validator VerifyCommitLight fused p50."""
     vs, commit, bid = make_ed_commit(10_000)
@@ -1583,11 +1687,86 @@ def smoke_sharded_layout(n_vals=300, n_strides=2):
     }
 
 
+def smoke_pipelined_deck(n_sigs=24):
+    """cfg12's host-only miniature: the flight-deck plumbing with no
+    jax in the process — the ledger's airborne/n_host/dev0 columns and
+    deck summary, the staging-pool depth wired to pipeline_flights,
+    the out-of-order landing picker, and the [verify_plane] knob path
+    into a live (host) plane."""
+    from cometbft_tpu.config.config import Config
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane import plane as vp
+
+    for col in ("airborne", "n_dev", "n_host", "dev0"):
+        assert col in vp.FlushLedger.FIELDS, col
+
+    # the ready-first landing picker: a later flight whose probe says
+    # ready lands FIRST (out-of-order — no head-of-line blocking);
+    # with no probe (or none ready) callers fall back to FIFO
+    class _F:
+        def __init__(self, ready):
+            self.ready = ready
+
+    deck = [_F(lambda: False), _F(lambda: True), _F(lambda: False)]
+    assert vp._ready_index(deck) == 1
+    assert vp._ready_index([_F(None), _F(lambda: False)]) is None
+
+    cfg = Config()
+    cfg.verify_plane.enable = True
+    cfg.verify_plane.pipeline_flights = 2
+    cfg.verify_plane.half_mesh_rows = 512
+    cfg.validate_basic()
+    plane = cfg.verify_plane.build()
+    assert plane.flights == 2 and plane.half_mesh_rows == 512
+    # the multi-flight staging contract: flights+1 slots per shape so
+    # pack(k+2) never lands in a buffer still pinned under flight k
+    assert plane._staging.slots == 3
+    plane.start()
+    try:
+        keys = [PrivKey.generate((9500 + i).to_bytes(4, "big")
+                                 + b"\x21" * 28) for i in range(n_sigs)]
+        t = _now_ms()
+        futs = [plane.submit(k.pub_key(), b"deck-%d" % i,
+                             k.sign(b"deck-%d" % i))
+                for i, k in enumerate(keys)]
+        verdicts = [f.result(10) for f in futs]
+        wall_ms = _now_ms() - t
+    finally:
+        plane.stop()
+    assert all(all(v) for v in verdicts), "valid sigs rejected"
+    dump = plane.dump_flushes()
+    recs = dump["flushes"]
+    # host flushes are synchronous: never airborne, single host+device,
+    # and the legacy overlapped bool derives from the airborne count
+    assert recs and all(
+        r["airborne"] == 0 and r["overlapped"] is False
+        and r["n_host"] == 1 and r["dev0"] == 0 for r in recs), recs
+    deck_sum = dump["summary"]["deck"]
+    assert deck_sum == {"airborne_max": 0, "overlapped_flushes": 0}
+    st = plane.stats()
+    assert st["flights"] == 2 and st["deck_peak"] == 0
+    assert st["halves"] == 0  # no mesh on a host plane
+    return {
+        "metric": "cfg12_smoke flight-deck plumbing",
+        "value": round(wall_ms, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {
+            "sigs": n_sigs,
+            "staging_slots": plane._staging.slots,
+            "deck_summary": deck_sum,
+            "ledger_cols": [c for c in ("airborne", "n_dev", "n_host",
+                                        "dev0")],
+        },
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
                  ("cfg10_smoke", smoke_gateway),
-                 ("cfg11_smoke", smoke_sharded_layout)]
+                 ("cfg11_smoke", smoke_sharded_layout),
+                 ("cfg12_smoke", smoke_pipelined_deck)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -1600,7 +1779,8 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg5", cfg5_light_secp), ("cfg6", cfg6_vote_plane),
                 ("cfg7", cfg7_pack_only), ("cfg8", cfg8_multichip_smoke),
                 ("cfg9", cfg9_sustained), ("cfg10", cfg10_gateway),
-                ("cfg11", cfg11_sharded_tally)]
+                ("cfg11", cfg11_sharded_tally),
+                ("cfg12", cfg12_pipelined)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 

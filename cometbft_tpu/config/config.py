@@ -170,6 +170,19 @@ class VerifyPlaneConfig:
     mesh: bool = False
     mesh_devices: int = 0
     mesh_min_rows: int = 256
+    # Pipelined mesh halves (the flight deck): pipeline_flights > 1
+    # keeps up to that many flushes airborne at once on DISJOINT
+    # sub-mesh halves — while one half verifies flush k, the other
+    # half flies flush k+1, so no chip idles between collect and
+    # dispatch. Needs a >=4-device mesh for real halves (each half
+    # runs the sharded program on >=2 chips); otherwise the deck
+    # degrades to the classic single-flight double buffer.
+    # half_mesh_rows caps how many rows a flush may carry and still
+    # ride a half (0 = budget-only: any flush whose stride count fits
+    # the half's 65536-slot/device budget takes it); a flush past the
+    # cap takes the full mesh and drains the deck first.
+    pipeline_flights: int = 1
+    half_mesh_rows: int = 0
 
     def build(self, metrics=None):
         """A VerifyPlane per this config, or None when disabled."""
@@ -189,6 +202,8 @@ class VerifyPlaneConfig:
             gateway_deadline_ms=self.gateway_deadline_ms,
             mesh_devices=self.mesh_devices if self.mesh else None,
             mesh_min_rows=self.mesh_min_rows,
+            pipeline_flights=self.pipeline_flights,
+            half_mesh_rows=self.half_mesh_rows,
         )
 
 
@@ -303,13 +318,18 @@ class Config:
         for name in ("bulk_window_ms", "bulk_max_queue",
                      "bulk_deadline_ms", "gateway_window_ms",
                      "gateway_max_queue", "gateway_deadline_ms",
-                     "mesh_devices", "mesh_min_rows"):
+                     "mesh_devices", "mesh_min_rows",
+                     "half_mesh_rows"):
             if getattr(self.verify_plane, name) < 0:
                 raise ConfigError(f"[verify_plane] {name} must be >= 0")
         if self.verify_plane.mesh_devices == 1:
             raise ConfigError(
                 "[verify_plane] mesh_devices must be 0 (all) or >= 2 — "
                 "a 1-device mesh is just the single-device path")
+        if self.verify_plane.pipeline_flights < 1:
+            raise ConfigError(
+                "[verify_plane] pipeline_flights must be >= 1 "
+                "(1 = classic single-flight dispatch)")
         lg = self.lightgate
         if lg.cache_size < 1:
             raise ConfigError("[lightgate] cache_size must be >= 1")

@@ -321,6 +321,14 @@ class NodeMetrics:
             "verifyplane", "shard_devices",
             "Resolved device fan-out of the verify plane's flush mesh "
             "(0 = single-device dispatch)")
+        # flight deck (pipelined mesh halves): set LIVE by the owning
+        # plane's dispatcher on every deck change — like shard_devices,
+        # NOT sampled at scrape time (the process-global plane may not
+        # be this node's, and an overwrite would clobber the live value)
+        self.plane_deck_airborne = r.gauge(
+            "verifyplane", "deck_airborne",
+            "Verify-plane flushes currently airborne on the flight "
+            "deck (2 = both mesh halves busy)")
         # light-client gateway (cometbft_tpu.lightgate): counters are
         # SAMPLED at scrape time from the mounted gateway's scrape-safe
         # stats()/cache_stats() — the gateway has no metrics handle of

@@ -11,6 +11,18 @@ the host packs into buffer B, and by the time A comes around again its
 H2D copy has long completed (JAX transfers the argument before the
 dispatch call returns).
 
+Depth must track the pipeline: a consumer keeping K transfers in
+flight needs K+1 slots so the pack never lands in a buffer a flight
+still reads from. The verify plane's flight deck sizes its private
+pool `pipeline_flights + 1` deep (a hardcoded 2 would silently alias
+the third concurrent pack); blocksync keeps its own 3-deep pool for
+its 2-in-flight window — the same rule. The rotation is strictly
+round-robin per key, NOT free-slot-aware: a consumer that completes
+transfers out of order must still retire them within the rotation
+window (the plane force-lands any flight older than `flights` packs
+before packing — plane.py's rotation-window bound), or pack m would
+zero the buffer pack m-(slots) left pinned.
+
 The arrays are ordinary page-locked-by-reuse host memory (numpy cannot
 ask for cudaHostAlloc-style pinning; steady reuse keeps the pages hot
 and resident, which is what the tunnel transport actually benefits

@@ -360,12 +360,22 @@ class Node(BaseService):
                 # only mesh-configured nodes reach here)
                 self.verify_plane._flush_mesh(
                     self.verify_plane.mesh_min_rows)
+                deck = ""
+                if self.verify_plane.mesh_ndev \
+                        and self.verify_plane.flights > 1:
+                    deck = (f", deck of {self.verify_plane.flights} "
+                            f"flights over "
+                            f"{len(self.verify_plane._halves)} halves"
+                            if self.verify_plane._halves
+                            else f", deck requested but <4 devices; "
+                                 f"single-flight")
                 print("verify plane mesh: "
                       + (f"{self.verify_plane.mesh_ndev}-device "
                          f"sharded dispatch"
                          if self.verify_plane.mesh_ndev
                          else "requested but <2 devices; "
-                              "single-device"))
+                              "single-device")
+                      + deck)
         if self.lightgate is not None:
             # after the plane: the gateway's batch_fn rides its GATEWAY
             # lane from the first request

@@ -10,6 +10,15 @@ gossip-aggregated `libs/bits` bitarrays + tally loop, SURVEY.md §2.6).
 
 Multi-host: the same code runs over a DCN-spanning mesh — XLA routes the
 psum hierarchically (ICI within pod slice, DCN across hosts).
+
+Sub-meshes: every step below is memoized by the EXACT device tuple
+(_mesh_key), so the verify plane's pipelined halves (fused.half_meshes
+— two disjoint sub-meshes flying alternating flushes) each compile
+their own program exactly once and hit the memo steady-state; a half
+and the full mesh never collide in the cache. The psum in each step
+reduces over its own mesh's axis only, which is what makes a flush
+complete within its half — its rows, table shards, and thresholds all
+live there (the deck's disjointness invariant).
 """
 from __future__ import annotations
 

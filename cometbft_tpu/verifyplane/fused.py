@@ -23,6 +23,19 @@ the voting-power tally psum-reduces ON DEVICE, so the quorum bit is
 still a kernel output — one cross-chip pass for a 100k-validator
 commit (a single chip's table budget caps at 65536 validator slots).
 
+Pipelined mesh halves ([verify_plane] pipeline_flights): the plane's
+flight deck keeps up to K flushes airborne at once on DISJOINT
+sub-meshes. half_meshes splits the flush mesh into two halves on the
+same device-prefix seam effective_mesh clamps through, and plan_fused
+carries the size-aware fan-out policy: a small flush rides the free
+half (its psum reduces over that half alone — every one of its rows
+and its whole table shard set live there, so the quorum bit is exact),
+while a flush past the half's per-device budget (or over the
+half_mesh_rows knob) takes the full mesh and sets ``drain_first`` so
+the dispatcher lands the airborne deck before dispatching it.
+plan_ready is the non-blocking landing probe that lets the deck settle
+flights out of order.
+
 This is the plane's TPU specialization; it is bypassed on CPU backends
 (the interpret-mode cached kernel costs minutes of compile) where the
 generic host path in plane._verify_rows serves the same semantics.
@@ -55,7 +68,8 @@ class _Plan:
 
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
                  "counted_pos", "n_commits", "pubs_v", "powers_v",
-                 "pending", "mesh", "n_dev", "thresh")
+                 "pending", "mesh", "n_dev", "thresh", "devs",
+                 "drain_first")
 
 
 def _eligible(batch):
@@ -123,19 +137,36 @@ def plane_mesh(devices: int):
     return m
 
 
-# sub-meshes over a mesh's device prefix, memoized by the exact device
-# tuple (also the seam the pipelined-mesh-halves stretch would use)
+# sub-meshes over a mesh's devices, memoized by the exact device tuple
+# (effective_mesh clamps through prefixes; half_meshes slices the same
+# memo into the deck's disjoint halves)
 _SUBMESH_MEMO: dict = {}
 
 
-def _sub_mesh(mesh, n_eff: int):
+def _sub_mesh_devs(devs: tuple):
     from cometbft_tpu.parallel import mesh as pm
 
-    devs = tuple(mesh.devices.flat)[:n_eff]
     m = _SUBMESH_MEMO.get(devs)
     if m is None:
         m = _SUBMESH_MEMO[devs] = pm.make_mesh(list(devs))
     return m
+
+
+def _sub_mesh(mesh, n_eff: int):
+    return _sub_mesh_devs(tuple(mesh.devices.flat)[:n_eff])
+
+
+def half_meshes(mesh) -> list:
+    """The flush mesh split into two DISJOINT halves for the pipelined
+    flight deck: lower half = device prefix, upper half = the rest.
+    Each half needs >= 2 devices to run the sharded fused program
+    pinned to its own chips, so meshes under 4 devices return [] and
+    the deck degrades to classic single-flight dispatch."""
+    if mesh is None or mesh.devices.size < 4:
+        return []
+    devs = tuple(mesh.devices.flat)
+    mid = len(devs) // 2
+    return [_sub_mesh_devs(devs[:mid]), _sub_mesh_devs(devs[mid:])]
 
 
 def effective_mesh(mesh, nvals: int):
@@ -170,13 +201,23 @@ def effective_mesh(mesh, nvals: int):
     return mesh, n_eff, m_s
 
 
-def plan_fused(batch, pool=None, mesh=None) -> Optional[_Plan]:
+def plan_fused(batch, pool=None, mesh=None, half=None,
+               half_max_rows: int = 0) -> Optional[_Plan]:
     """Host-side staging of the fused cached-table dispatch for a
     flush. Returns a _Plan, or None when the flush shape is ineligible
     — the caller then runs the generic grouped path. No device work
     happens here (dispatch_fused/collect_fused do that, under the
     breaker). `mesh` (a >1-device parallel.mesh Mesh) selects the
-    sharded cross-chip layout; None is the single-device path."""
+    sharded cross-chip layout; None is the single-device path.
+
+    `half` is the flight deck's fan-out offer: a free sub-mesh half
+    the flush should prefer so it can fly while the other half carries
+    an airborne flight. The size-aware policy lives here because only
+    the plan knows the flush's true shape: the half is taken when the
+    valset and stride count fit its per-device budget AND the flush is
+    under `half_max_rows` (0 = budget-only); otherwise the flush takes
+    the full `mesh` and the plan's ``drain_first`` flag tells the
+    dispatcher to land the airborne deck before dispatching it."""
     import jax
 
     if jax.default_backend() == "cpu" and not ALLOW_CPU_FUSED:
@@ -190,13 +231,6 @@ def plan_fused(batch, pool=None, mesh=None) -> Optional[_Plan]:
     from cometbft_tpu.ops import ed25519_cached as ec
     from cometbft_tpu.ops import ed25519_kernel as ek
     from cometbft_tpu.ops.ed25519_pallas import _PB
-
-    try:
-        # clamp to the devices this valset fills (empty shards would
-        # verify pure padding); M == table_pad(nvals) when unsharded
-        mesh, n_dev, M = effective_mesh(mesh, nvals)
-    except ValueError:
-        return None  # valset over even the sharded table budget
 
     # slot assignment: first free stride wins (a validator's vote and
     # its extension land in different strides); positions are computed
@@ -242,10 +276,34 @@ def plan_fused(batch, pool=None, mesh=None) -> Optional[_Plan]:
         counted_ridx.append(cidx)
     n = len(pubs)
     n_strides = len(occupied)
-    # the rows budget is PER DEVICE: each chip runs the kernel on its
-    # B/n_dev slice, so a sharded flush scales the cap with the mesh
-    if n == 0 or n_strides * M > MAX_FUSED_ROWS:
+    if n == 0:
         return None
+
+    # fan-out policy. The rows budget is PER DEVICE: each chip runs
+    # the kernel on its B/n_dev slice, so a sharded flush scales the
+    # cap with the mesh — a half offers half the budget at half the
+    # dispatch footprint. effective_mesh clamps either choice to the
+    # devices the valset actually fills.
+    def _fit(m):
+        m2, nd, ms = effective_mesh(m, nvals)
+        if n_strides * ms > MAX_FUSED_ROWS:
+            raise ValueError("flush over the per-device rows budget")
+        return m2, nd, ms
+
+    chosen = None
+    took_full = False
+    if half is not None and (not half_max_rows or n <= half_max_rows):
+        try:
+            chosen = _fit(half)
+        except ValueError:
+            chosen = None  # giant flush: the full mesh decides below
+    if chosen is None:
+        took_full = half is not None
+        try:
+            chosen = _fit(mesh)
+        except ValueError:
+            return None  # over even the full mesh's table budget
+    mesh, n_dev, M = chosen
     B = n_dev * n_strides * M
 
     n_commits = len(groups)
@@ -310,7 +368,27 @@ def plan_fused(batch, pool=None, mesh=None) -> Optional[_Plan]:
     plan.mesh = mesh
     plan.n_dev = n_dev
     plan.thresh = thresh
+    # device ids this flush will occupy (None = single-device): the
+    # deck's disjointness bookkeeping and the ledger's dev0 column
+    plan.devs = (None if mesh is None
+                 else tuple(int(d.id) for d in mesh.devices.flat))
+    plan.drain_first = took_full
     return plan
+
+
+def plan_ready(plan: _Plan) -> bool:
+    """Non-blocking landing probe for a dispatched plan: True when
+    every in-flight output array is ready to fetch. The deck lands
+    ready flights out of order (no head-of-line blocking when flight
+    k+1 finishes before flight k); False — including when the runtime
+    offers no probe — means the caller falls back to FIFO landing."""
+    p = plan.pending
+    if p is None:
+        return True
+    try:
+        return all(bool(a.is_ready()) for a in p)
+    except Exception:  # noqa: BLE001 - no readiness probe: FIFO lands
+        return False
 
 
 def plan_h2d_bytes(plan: _Plan) -> int:

@@ -36,6 +36,19 @@ tables, tally psum-reduced on device, quorum still a kernel output —
 one cross-chip pass for commits past a single chip's valset ceiling
 (fused.py "Multichip").
 
+Flight deck (pipeline_flights > 1): the dispatcher keeps up to K
+flushes airborne at once instead of a single in-flight slot. With a
+>=4-device mesh the flush mesh splits into two DISJOINT halves
+(fused.half_meshes) and alternating flushes fly on alternating halves
+— while flush k verifies on one half, flush k+1 packs on the host AND
+dispatches on the other half, so no chip idles between collect(k) and
+dispatch(k+1). Landing is out-of-order (fused.plan_ready probes
+readiness; flight k+1 finishing first never blocks behind k), and the
+size-aware policy in fused.plan_fused sends a flush past one half's
+budget (or the half_mesh_rows knob) to the full mesh after draining
+the deck. The private staging pool is flights+1 deep per shape so
+pack(k+2) never waits on a buffer still pinned under flight k.
+
 QoS lanes (overload resilience): every submission rides one of three
 priority classes.  CONSENSUS (the default: gossiped votes, commits,
 the node's own light-client headers) owns the flush window — its
@@ -141,11 +154,12 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 # very same list becomes the ring slot — "no allocation per flush beyond
 # the ring slot" is literal, not approximate.
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
- _L_COLLECT, _L_SETTLE, _L_OVER, _L_PATH, _L_BRK, _L_SMISS,
- _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV) = range(19)
+ _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_BRK, _L_SMISS,
+ _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
+ _L_NHOST, _L_DEV0) = range(21)
 # internal slots past the FIELDS window: two ns stamps + the clock
 # generation they were taken under (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN = 19, 20, 21
+_L_T0NS, _L_TPACKED, _L_GEN = 21, 22, 23
 
 
 class FlushLedger:
@@ -153,22 +167,28 @@ class FlushLedger:
 
     Record fields (see ``FIELDS``): per-plane sequence number, flush
     timestamp (ms on the ledger clock), row/submission counts, the
-    per-stage costs (queued/pack/flight/collect/settle ms), whether the
-    pack overlapped an airborne flight, the dispatch path taken, the
-    breaker state observed at stage time, staging-pool misses charged
-    to this flush, the queue depth left behind, the per-lane row split
-    (c_rows CONSENSUS / g_rows GATEWAY / b_rows BULK), and how many
-    sheddable-lane submissions were shed at this drain, and the device
-    fan-out n_dev (1 = single-device/host pass, >1 = the cross-chip
-    sharded mesh pass — so /dump_flushes can attribute multichip
-    flushes). Written by the dispatcher even when tracing is off; read
-    by /dump_flushes, the scrape-time /metrics percentiles, and simnet
+    per-stage costs (queued/pack/flight/collect/settle ms), how many
+    OTHER flights were airborne when this flush dispatched (``airborne``
+    — the flight-deck generalization of the old boolean overlap flag;
+    records() still derives the legacy ``overlapped`` bool from it),
+    the dispatch path taken, the breaker state observed at stage time,
+    staging-pool misses charged to this flush, the queue depth left
+    behind, the per-lane row split (c_rows CONSENSUS / g_rows GATEWAY /
+    b_rows BULK), how many sheddable-lane submissions were shed at
+    this drain, and the flush's sub-mesh attribution: n_dev (1 =
+    single-device/host pass, >1 = the cross-chip sharded mesh pass),
+    n_host (always 1 today — pre-plumbed for the multi-host DCN round)
+    and dev0 (first device id of the flush's sub-mesh, so two deck
+    flights on disjoint halves are visibly disjoint in /dump_flushes).
+    Written by the dispatcher even when tracing is off; read by
+    /dump_flushes, the scrape-time /metrics percentiles, and simnet
     replay blobs."""
 
     FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
-              "flight_ms", "collect_ms", "settle_ms", "overlapped",
+              "flight_ms", "collect_ms", "settle_ms", "airborne",
               "path", "breaker", "staging_miss", "depth",
-              "c_rows", "g_rows", "b_rows", "shed", "n_dev")
+              "c_rows", "g_rows", "b_rows", "shed", "n_dev",
+              "n_host", "dev0")
 
     __slots__ = ("_ring",)
 
@@ -187,7 +207,15 @@ class FlushLedger:
         # list(deque) snapshots atomically under the GIL (one C call);
         # zip(FIELDS, r) stops at the FIELDS window, so the two internal
         # ns stamps trailing each record never leak into a dump
-        return [dict(zip(self.FIELDS, r)) for r in list(self._ring)]
+        out = []
+        for r in list(self._ring):
+            d = dict(zip(self.FIELDS, r))
+            # legacy key: "overlapped" was a bool before the deck
+            # widened it to the airborne count — derived at READ time
+            # so /dump_flushes consumers keep working
+            d["overlapped"] = bool(d["airborne"])
+            out.append(d)
+        return out
 
     def tail(self, n: int = 8) -> List[str]:
         """The last n flushes as compact strings — small enough to ride
@@ -200,7 +228,7 @@ class FlushLedger:
                 f"flight={r[_L_FLIGHT]}ms collect={r[_L_COLLECT]}ms "
                 f"settle={r[_L_SETTLE]}ms"
                 + (f" x{r[_L_NDEV]}dev" if r[_L_NDEV] > 1 else "")
-                + (" overlapped" if r[_L_OVER] else "")
+                + (f" air={r[_L_AIR]}" if r[_L_AIR] else "")
             )
         return out
 
@@ -221,7 +249,7 @@ class FlushLedger:
 
         pack_total = sum(cols["pack_ms"])
         pack_over = sum(p for p, o in zip(cols["pack_ms"],
-                                          cols["overlapped"]) if o)
+                                          cols["airborne"]) if o)
         paths: dict = {}
         for p in cols["path"]:
             paths[p] = paths.get(p, 0) + 1
@@ -251,6 +279,14 @@ class FlushLedger:
                                                   cols["n_dev"])
                                 if d > 1)),
                 "n_dev_max": int(max(cols["n_dev"], default=0)),
+            },
+            # flight-deck attribution: how deep the deck actually got
+            # (airborne = flights already in the air at dispatch time,
+            # so airborne_max == 1 means two flights flew at once)
+            "deck": {
+                "airborne_max": int(max(cols["airborne"], default=0)),
+                "overlapped_flushes": sum(
+                    1 for a in cols["airborne"] if a),
             },
         }
 DEFAULT_RESULT_TIMEOUT = 30.0
@@ -410,6 +446,45 @@ class _Submission:
         self.tid = threading.get_ident()
 
 
+class _Flight:
+    """One staged flush on the dispatcher's deck: the submissions, the
+    deferred finish() that blocks for verdicts, whether a device pass
+    is genuinely airborne, the flush id, the ledger scratch record,
+    the device ids the pass occupies (None = single-device/host — the
+    deck's disjoint-halves bookkeeping), and an optional non-blocking
+    readiness probe for out-of-order landing."""
+
+    __slots__ = ("batch", "finish", "airborne", "fid", "led", "devs",
+                 "ready", "pack_idx")
+
+    def __init__(self, batch, finish, airborne, fid, led, devs=None,
+                 ready=None, pack_idx=0):
+        self.batch = batch
+        self.finish = finish
+        self.airborne = airborne
+        self.fid = fid
+        self.led = led
+        self.devs = devs
+        self.ready = ready
+        # per-plane pack ordinal: the staging pool rotates flights+1
+        # slots round-robin, so pack m reuses pack m-(flights+1)'s
+        # buffers — the dispatcher force-lands any flight that old
+        # before packing (the rotation-window safety bound on
+        # out-of-order landing)
+        self.pack_idx = pack_idx
+
+
+def _ready_index(deck) -> Optional[int]:
+    """Index of the first deck flight whose readiness probe says its
+    results are fetchable without blocking, or None. The probe is how
+    the deck lands out of order: when flight k+1 finishes first, it
+    settles first — no head-of-line blocking behind flight k."""
+    for i, f in enumerate(deck):
+        if f.ready is not None and f.ready():
+            return i
+    return None
+
+
 def _host_verdicts(rows) -> List[bool]:
     """Inline host path: per-row single verify via the reference-path
     PubKey.verify_signature (ed25519_ref and friends)."""
@@ -437,7 +512,9 @@ class VerifyPlane:
                  gateway_max_queue: Optional[int] = None,
                  gateway_deadline_ms: float = 500.0,
                  mesh_devices: Optional[int] = None,
-                 mesh_min_rows: int = 256):
+                 mesh_min_rows: int = 256,
+                 pipeline_flights: int = 1,
+                 half_mesh_rows: int = 0):
         from cometbft_tpu.crypto import batch as cbatch
         from cometbft_tpu.libs.staging import StagingPool
 
@@ -521,14 +598,28 @@ class VerifyPlane:
         self.shard_flushes = 0     # flushes dispatched cross-chip
         self.shard_rows = 0        # rows those flushes carried
         self.mesh_ndev = 0         # resolved fan-out (0 = single-dev)
+        # flight deck (pipelined mesh halves): up to `flights` flushes
+        # airborne at once; with a >=4-device mesh they alternate over
+        # disjoint halves (resolved with the mesh). half_mesh_rows is
+        # the policy knob: a flush over it takes the full mesh.
+        self.flights = max(1, int(pipeline_flights))
+        self.half_mesh_rows = max(0, int(half_mesh_rows))
+        self._halves: list = []    # resolved with the mesh
+        self.deck_airborne = 0     # flights airborne right now
+        self.deck_peak = 0         # deepest the deck ever got
+        self._packs = 0            # pack ordinal (rotation-window bound)
         # always-on flush ledger (bounded ring; survives stop() — it is
         # read-only history, never cleared by the lifecycle)
         self.ledger = FlushLedger()
         self._flush_seq = itertools.count()  # per-plane, deterministic
         # PRIVATE staging pool: the rotation contract (one writer per
         # key) only holds per dispatcher thread — two planes in one
-        # process (multi-node tests, simnet) must never share slots
-        self._staging = StagingPool(slots=2)
+        # process (multi-node tests, simnet) must never share slots.
+        # Depth tracks the deck: up to `flights` flushes pin their
+        # buffers under airborne flights while the next one packs, so
+        # flights+1 slots keep pack(k+2) off flight k's memory (the
+        # old hardcoded 2 silently aliased the third pack's buffers)
+        self._staging = StagingPool(slots=self.flights + 1)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -592,8 +683,9 @@ class VerifyPlane:
                 len(settle), 0.0, 0.0, 0.0,
                 round((t1 - t0) / 1e6, 3),
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
-                False, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
+                0, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
+                1, 0,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -729,16 +821,20 @@ class VerifyPlane:
     # -- dispatcher --------------------------------------------------------
 
     def _run(self) -> None:
-        """Double-buffered dispatch loop: while flush k flies on the
-        device, the dispatcher drains and PACKS flush k+1 into the
-        rotated staging buffers (libs/staging.py), settling k only
-        after k+1's dispatch is in flight — the blocksync pipeline's
+        """Flight-deck dispatch loop: while up to `flights` flushes fly
+        on the device (on DISJOINT sub-mesh halves when the mesh and
+        pipeline_flights allow), the dispatcher drains and PACKS the
+        next flush into the rotated staging buffers (libs/staging.py)
+        and dispatches it onto a free half — the blocksync pipeline's
         overlap (pipeline.py "host packs chunk k+1 while the device
-        works"), generalized to every caller of the plane. With a
-        flush already in flight the window wait is skipped: the
-        in-flight pass IS the coalescing amortization the window
-        exists to provide."""
-        inflight = None  # airborne (batch, finish, True, flush_id, led)
+        works"), generalized to every caller AND to device parallelism.
+        Airborne flights land out of order via the readiness probe, so
+        flight k+1 finishing early never waits behind k. With any
+        flight airborne the window wait is skipped: the in-flight pass
+        IS the coalescing amortization the window exists to provide.
+        pipeline_flights=1 is exactly the classic single-slot double
+        buffer."""
+        deck: List[_Flight] = []  # airborne flights, dispatch order
         while True:
             batch: List[_Submission] = []
             shed: List[_Submission] = []
@@ -761,7 +857,7 @@ class VerifyPlane:
                         # flush past its deadline — their rows only
                         # ride along
                         age = time.perf_counter() - cq[0].t_submit
-                        if (inflight is not None
+                        if (deck
                                 or age >= self.window
                                 or self._pending_rows[LANE_CONSENSUS]
                                 >= self.max_batch):
@@ -770,14 +866,14 @@ class VerifyPlane:
                     elif waitq is not None:
                         win = self.lane_window[wait_lane]
                         age = time.perf_counter() - waitq[0].t_submit
-                        if (inflight is not None
+                        if (deck
                                 or age >= win
                                 or self._pending_rows[wait_lane]
                                 >= self.max_batch):
                             break
                         self._cv.wait(timeout=win - age)
-                    elif inflight is not None:
-                        break  # nothing to pack: settle the flight now
+                    elif deck:
+                        break  # nothing to pack: land a flight
                     else:
                         self._cv.wait(timeout=0.25)
                 if not self._running \
@@ -862,38 +958,126 @@ class VerifyPlane:
                     t = tracing.monotonic_ns()
                     self.ledger.record([
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
-                        0.0, 0.0, 0.0, 0.0, 0.0, False, PATH_SHED_ONLY,
+                        0.0, 0.0, 0.0, 0.0, 0.0, 0, PATH_SHED_ONLY,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed), 0,
+                        len(shed), 0, 0, 0,
                     ])
-            flight = self._stage(batch, depth, shed_n=len(shed)) \
-                if batch else None
-            if inflight is not None:
-                # real overlap only: the previous flight was airborne on
-                # the device while this flush packed on the host
-                if flight is not None:
-                    self.overlapped += 1
-                    flight[4][_L_OVER] = True
-                self._finish_flight(inflight)
-                inflight = None
-            if flight is not None:
-                if flight[2]:
-                    inflight = flight  # device pass in flight: defer
-                else:
-                    # synchronous flush (host path / grouped device):
-                    # verdicts are already final — settle NOW, deferring
-                    # would add a whole flush of latency for no overlap
-                    self._finish_flight(flight)
-        if inflight is not None:
-            self._finish_flight(inflight)
+            if not batch:
+                # nothing to pack: land a flight (the first READY one,
+                # else wait briefly for new work or readiness — landing
+                # the oldest blind would block the dispatcher exactly
+                # when a new flush could fly the free half)
+                if deck:
+                    self._land_or_wait(deck)
+                continue
+            # staging-rotation safety: the pool hands pack m the very
+            # buffers pack m-(flights+1) filled, so a flight that old
+            # must LAND (FIFO, blocking) before this pack may touch
+            # its memory — out-of-order landing is free only within
+            # the pool's rotation window, never across it
+            while deck and deck[0].pack_idx <= self._packs - self.flights:
+                self._finish_flight(deck.pop(0))
+                self._deck_update(deck)
+            flight = self._stage(batch, depth, shed_n=len(shed),
+                                 deck=deck)
+            # flights in the air at dispatch time (post any drain the
+            # fan-out policy forced): the ledger's airborne column and
+            # the overlap counter — a real overlap means this flush
+            # packed on the host while >=1 flight flew on the device
+            air = len(deck)
+            flight.led[_L_AIR] = air
+            if air:
+                self.overlapped += 1
+            if flight.airborne:
+                deck.append(flight)
+                self._deck_update(deck)
+                while len(deck) > self.flights:
+                    self._land_one(deck)
+            else:
+                # synchronous flush (host path / grouped device):
+                # verdicts are already final — land the airborne deck
+                # first (its flights dispatched earlier), then settle
+                # NOW; deferring would add a whole flush of latency
+                # for no overlap
+                while deck:
+                    self._land_one(deck)
+                self._finish_flight(flight)
+        while deck:
+            self._land_one(deck)
 
-    def _finish_flight(self, flight) -> None:
+    def _land_one(self, deck: List[_Flight]) -> None:
+        """Land one deck flight: the first READY one (out-of-order —
+        flight k+1 landing first never blocks behind k), else the
+        oldest (FIFO; its collect blocks until the device finishes)."""
+        idx = _ready_index(deck)
+        self._finish_flight(deck.pop(0 if idx is None else idx))
+        self._deck_update(deck)
+
+    def _land_or_wait(self, deck: List[_Flight]) -> None:
+        """Idle-deck landing: settle a READY flight immediately; with
+        none ready, poll in short slices for readiness or new work for
+        up to one window (new work wins — it can fly a free half while
+        the deck stays airborne), then land FIFO regardless: futures
+        must resolve even when the runtime offers no readiness probe.
+        Only ever called with device flights airborne, so the simnet
+        host path (and its ledger determinism) never touches the
+        real-clock polling here."""
+        idx = _ready_index(deck)
+        if idx is None:
+            deadline = time.perf_counter() + max(self.window, 0.1)
+            while True:
+                with self._cv:
+                    if self._running and not self._depth_locked():
+                        self._cv.wait(timeout=0.005)
+                    if self._depth_locked():
+                        return  # pack the new flush first
+                idx = _ready_index(deck)
+                if idx is not None or not self._running \
+                        or time.perf_counter() >= deadline:
+                    break
+            if idx is None:
+                idx = 0  # probe can't tell: land FIFO, collect blocks
+        self._finish_flight(deck.pop(idx))
+        self._deck_update(deck)
+
+    def _deck_update(self, deck: List[_Flight]) -> None:
+        n = len(deck)
+        self.deck_airborne = n
+        if n > self.deck_peak:
+            self.deck_peak = n
+        if self.metrics is not None:
+            self.metrics.plane_deck_airborne.set(float(n))
+
+    def _pick_half(self, deck: List[_Flight]):
+        """The sub-mesh half the next fused flush should prefer: a
+        half with NO airborne flight (disjoint devices — both halves
+        fly at once), else the OLDEST flight's half (it lands soonest;
+        the new flush queues behind it on that half exactly like the
+        classic single slot queued behind the one in-flight pass)."""
+        halves = self._halves
+        if not halves or self.flights < 2:
+            return None
+        busy = set()
+        for f in deck:
+            busy.update(f.devs or ())
+        for h in halves:
+            if busy.isdisjoint(int(d.id) for d in h.devices.flat):
+                return h
+        old = deck[0].devs or ()
+        for h in halves:
+            if old and old[0] in {int(d.id) for d in h.devices.flat}:
+                return h
+        return halves[0]
+
+    def _finish_flight(self, flight: _Flight) -> None:
         # hook audit (r05 post-mortem suspect #1): every tracing span
         # here sits behind an `enabled()` check so the DISABLED path
         # constructs no span object and no kwargs dict — the only
         # per-flush bookkeeping is the ledger stamps (plain int clock
         # reads) and the ring tuple.
-        batch, finish, airborne, fid, led = flight
+        batch, finish, airborne, fid, led = (
+            flight.batch, flight.finish, flight.airborne, flight.fid,
+            flight.led)
         traced = tracing.enabled()
         t_exec = tracing.monotonic_ns()
         if airborne:
@@ -947,20 +1131,24 @@ class VerifyPlane:
                 self.metrics.plane_h2d_bytes.inc(h2d_bytes)
 
     def _stage(self, batch: List[_Submission], depth: int = 0,
-               shed_n: int = 0):
+               shed_n: int = 0, deck: List[_Flight] = ()):
         """Pack one flush and (when eligible) launch it on the device
-        WITHOUT waiting for results. Returns (batch, finish, airborne,
-        flush_id, ledger_scratch) where finish() blocks for the
-        verdicts — the seam that lets the dispatcher pack the next
-        flush while this one flies. The whole host-side staging is one
-        "plane.pack" trace span keyed by flush id, so pack(k+1) visibly
-        overlaps device-flight(k) in the exported timeline.
+        WITHOUT waiting for results. Returns a _Flight whose finish()
+        blocks for the verdicts — the seam that lets the dispatcher
+        pack the next flush while this one (and the rest of the deck)
+        flies. `deck` is the airborne flights: the fan-out policy picks
+        a disjoint half for this flush, and a flush the policy sends to
+        the full mesh lands the deck before dispatching. The whole
+        host-side staging is one "plane.pack" trace span keyed by
+        flush id, so pack(k+1) visibly overlaps device-flight(k) in
+        the exported timeline.
 
         Ledger accounting happens on BOTH paths: the disabled-tracing
         fast path still stamps the clock and fills the scratch list
         (ints and interned strings only — no dict/span construction,
         the r05 post-mortem's suspect #1)."""
         fid = next(_FLUSH_IDS)
+        self._packs += 1
         t0 = tracing.monotonic_ns()
         gen = tracing.clock_gen()
         t_min = None
@@ -985,24 +1173,26 @@ class VerifyPlane:
         # FIELDS-ordered record + internal slots (t0, t_packed, clock
         # gen); this list IS the eventual ring slot
         led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
-               len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, False,
+               len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, 0,
                PATH_HOST, self._breaker.state, 0, depth,
-               c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, t0,
-               t0, gen]
+               c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
+               0, t0, t0, gen]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path
-            batch, finish, airborne = self._stage_inner(batch, fid, led)
+            finish, airborne, devs, ready = self._stage_inner(
+                batch, fid, led, deck)
         else:
             with tracing.span("plane.pack", cat="verifyplane", flush=fid,
                               rows=rows, subs=len(batch),
                               queued_ms=queued_ms):
-                batch, finish, airborne = self._stage_inner(batch, fid,
-                                                            led)
+                finish, airborne, devs, ready = self._stage_inner(
+                    batch, fid, led, deck)
         t1 = tracing.monotonic_ns()
         led[_L_PACK] = round((t1 - t0) / 1e6, 3)
         led[_L_TPACKED] = t1
-        return batch, finish, airborne, fid, led
+        return _Flight(batch, finish, airborne, fid, led, devs, ready,
+                       pack_idx=self._packs)
 
     def _flush_mesh(self, rows: int):
         """The mesh a fused flush of `rows` rows should shard over, or
@@ -1022,11 +1212,17 @@ class VerifyPlane:
             self._mesh_resolved = True
             self.mesh_ndev = (0 if self._mesh is None
                               else int(self._mesh.devices.size))
+            if self.flights > 1 and self._mesh is not None:
+                # the deck's disjoint halves ride the same memoized
+                # sub-mesh seam effective_mesh clamps through; meshes
+                # under 4 devices have none (single-flight dispatch)
+                self._halves = fz.half_meshes(self._mesh)
             if self.metrics is not None:
                 self.metrics.plane_shard_ndev.set(float(self.mesh_ndev))
         return self._mesh
 
-    def _stage_inner(self, batch: List[_Submission], fid: int, led):
+    def _stage_inner(self, batch: List[_Submission], fid: int, led,
+                     deck: List[_Flight] = ()):
         """The breaker's allow() — which consumes the single half-open
         probe slot when the breaker is open — is only asked once a
         fused plan exists, i.e. when a device attempt will actually
@@ -1046,20 +1242,32 @@ class VerifyPlane:
             # measures staging only (the finish runs immediately for
             # synchronous flushes — same thread, same ordering)
             led[_L_PATH] = PATH_FAILPOINT
-            return batch, (lambda: (_host_verdicts(rows), None)), False
+            return (lambda: (_host_verdicts(rows), None)), False, \
+                None, None
         plan = None
         if self._use_device and self._kernels is None:
             from cometbft_tpu.verifyplane import fused as fz
 
             try:
+                mesh = self._flush_mesh(len(rows))
+                half = self._pick_half(deck) if mesh is not None \
+                    else None
                 plan = fz.plan_fused(batch, pool=self._staging,
-                                     mesh=self._flush_mesh(len(rows)))
+                                     mesh=mesh, half=half,
+                                     half_max_rows=self.half_mesh_rows)
             except Exception:  # noqa: BLE001 - staging bug, not device
                 _log.exception("fused flush staging failed; grouped path")
                 plan = None
             if plan is not None and not self._breaker.allow():
                 plan = None
         if plan is not None:
+            if plan.drain_first and deck:
+                # the policy sent this flush to the FULL mesh while
+                # half-flights are airborne: land the deck before the
+                # dispatch so the giant flush owns every chip at once
+                # instead of queueing piecemeal behind the halves
+                while deck:
+                    self._land_one(deck)
             try:
                 # [tracing] profile_dir: bracket the device flight with
                 # a jax.profiler capture so device traces line up with
@@ -1074,6 +1282,7 @@ class VerifyPlane:
                 if plan.mesh is not None:
                     led[_L_PATH] = PATH_FUSED_SHARDED
                     led[_L_NDEV] = plan.n_dev
+                    led[_L_DEV0] = plan.devs[0]
                 else:
                     led[_L_PATH] = PATH_FUSED
                 led[_L_SMISS] = self._staging.misses - miss0
@@ -1095,6 +1304,7 @@ class VerifyPlane:
                         # disagree with host_fallback — the PR-7 shed
                         # column lesson)
                         led[_L_NDEV] = 1
+                        led[_L_DEV0] = 0
                         return _host_verdicts(rows), None
                     finally:
                         if prof is not None:
@@ -1110,7 +1320,10 @@ class VerifyPlane:
                             self.metrics.plane_shard_rows.inc(len(rows))
                     return out
 
-                return batch, finish, True
+                # the module-attr lookup keeps the probe patchable
+                # (the forced-4-device deck test gates it)
+                return finish, True, plan.devs, \
+                    (lambda: fz.plan_ready(plan))
             except Exception:  # noqa: BLE001 - device fault at dispatch
                 if prof is not None:
                     prof()  # un-bracket a failed dispatch
@@ -1125,7 +1338,8 @@ class VerifyPlane:
         # deferred like the failpoint arm: pack_seconds (and the
         # plane.pack span) cover staging; the host/grouped verify runs
         # inside finish() under its own plane.verify span
-        return batch, (lambda: (self._verify_rows(rows), None)), False
+        return (lambda: (self._verify_rows(rows), None)), False, \
+            None, None
 
     def _verify_rows(self, rows) -> List[bool]:
         """One padded device pass under the circuit breaker, or the
@@ -1219,6 +1433,10 @@ class VerifyPlane:
             "mesh_ndev": self.mesh_ndev,
             "shard_flushes": self.shard_flushes,
             "shard_rows": self.shard_rows,
+            "flights": self.flights,
+            "halves": len(self._halves),
+            "deck_airborne": self.deck_airborne,
+            "deck_peak": self.deck_peak,
         }
 
     def lane_depths(self) -> dict:

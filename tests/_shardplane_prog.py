@@ -278,6 +278,329 @@ assert plane_f.sheds["bulk"] >= 1
 plane_f.stop()
 fz.dispatch_fused = real_dispatch
 
+# ==========================================================================
+# ISSUE 11: the pipelined flight deck — phases E (two flights airborne
+# on DISJOINT halves + out-of-order landing), F (giant flush takes the
+# full mesh and drains the deck FIRST), G (breaker trip mid-deck
+# degrades every airborne flight to correct host verdicts).
+# ==========================================================================
+
+import threading
+import time as _time
+
+mesh4b = fz.plane_mesh(0)
+halves = fz.half_meshes(mesh4b)
+assert len(halves) == 2
+ids_lo = tuple(int(d.id) for d in halves[0].devices.flat)
+ids_hi = tuple(int(d.id) for d in halves[1].devices.flat)
+assert ids_lo == (0, 1) and ids_hi == (2, 3), (ids_lo, ids_hi)
+# a 300-validator valset fills both devices of either half, and the
+# half memo rides the same sub-mesh seam effective_mesh clamps through
+assert fz.effective_mesh(halves[0], NVALS)[1] == 2
+assert fz.effective_mesh(halves[1], NVALS)[1] == 2
+assert fz.half_meshes(mesh4b)[0] is halves[0]
+# meshes under 4 devices offer no halves (deck degrades single-flight)
+assert fz.half_meshes(halves[0]) == []
+
+# the deck's 42-submission fixture: 21-sub waves of the standard
+# vote+ext shape (the 43rd submitter is dropped so both waves drain as
+# exactly one max_batch=42-row flush each)
+E_N = 42
+probe42 = make_batch([None, None])[:E_N]
+exp42 = [e for *_, e in probe42]
+tallies42 = [0, 0]
+for _rows, _vidx, _g, _pw, _e in probe42:
+    if all(_e):
+        tallies42[_vidx[0] % 2] += _pw
+THR42 = [tallies42[0], tallies42[1] + 1]
+
+
+def drive_waves(plane, groups, on_wave1=None):
+    subs = make_batch(groups)[:E_N]
+    futs = []
+    for rows, vidx, g, pw, _ in subs[:E_N // 2]:
+        futs.append(plane.submit_many(rows, power=pw, group=g,
+                                      counted=True, vidx=vidx))
+    if on_wave1 is not None:
+        on_wave1()
+    for rows, vidx, g, pw, _ in subs[E_N // 2:]:
+        futs.append(plane.submit_many(rows, power=pw, group=g,
+                                      counted=True, vidx=vidx))
+    return futs
+
+
+def wait_until(cond, timeout=30.0, what="condition"):
+    t0 = _time.monotonic()
+    while not cond():
+        if _time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {what}")
+        _time.sleep(0.002)
+
+
+# ---- phase E oracle: the same 42 submissions, single-flight ----------
+
+plane_o = VerifyPlane(window_ms=40.0, max_batch=E_N, use_device=True,
+                      mesh_devices=0, mesh_min_rows=1)
+plane_o.start()
+groups_o = new_groups(THR42)
+futs_o = drive_waves(plane_o, groups_o)
+verd_o = [f.result(30.0) for f in futs_o]
+plane_o.stop()
+assert verd_o == exp42, "single-flight oracle verdicts wrong"
+assert [g.tally for g in groups_o] == tallies42
+assert [g.quorum_reached for g in groups_o] == [True, False]
+
+# ---- gates: hold staging, block collects, fake the readiness probe ----
+
+real_dispatch_e = fz.dispatch_fused
+real_collect_e = fz.collect_fused
+real_ready_e = fz.plan_ready
+dispatched = []
+release = {}
+collect_entered = {}
+fault_ids = set()
+gate_hold = {"fn": None}
+
+
+def gated_dispatch(plan):
+    real_dispatch_e(plan)
+    release[id(plan)] = threading.Event()
+    collect_entered[id(plan)] = threading.Event()
+    dispatched.append(plan)
+    h = gate_hold["fn"]
+    if h is not None:
+        h(plan)
+
+
+def gated_collect(plan):
+    ev = release.get(id(plan))
+    if ev is not None:
+        collect_entered[id(plan)].set()
+        assert ev.wait(60.0), "collect gate timed out"
+    if id(plan) in fault_ids:
+        raise RuntimeError("injected mid-deck device fault")
+    return real_collect_e(plan)
+
+
+def gated_ready(plan):
+    ev = release.get(id(plan))
+    return ev.is_set() if ev is not None else real_ready_e(plan)
+
+
+fz.dispatch_fused = gated_dispatch
+fz.collect_fused = gated_collect
+fz.plan_ready = gated_ready
+
+# ---- phase E: disjoint halves, out-of-order landing -------------------
+
+e_hold = threading.Event()
+e_base = len(dispatched)
+gate_hold["fn"] = lambda plan: (
+    e_hold.wait(30.0) if len(dispatched) == e_base + 1 else None)
+
+# window >> test: flushes trigger on max_batch rows, never the clock;
+# holding flush 1's staging (the dispatch gate) until wave 2 is FULLY
+# queued makes the two-flush split deterministic (with the deck
+# airborne the dispatcher drains without waiting the window)
+plane_p = VerifyPlane(window_ms=30_000.0, max_batch=E_N,
+                      use_device=True, mesh_devices=0, mesh_min_rows=1,
+                      pipeline_flights=2)
+plane_p.start()
+groups_p = new_groups(THR42)
+futs_p = drive_waves(
+    plane_p, groups_p,
+    on_wave1=lambda: wait_until(
+        lambda: len(dispatched) == e_base + 1,
+        what="flight 1 dispatch"))
+e_hold.set()  # wave 2 fully queued: let flight 1's staging finish
+wait_until(lambda: len(dispatched) == e_base + 2,
+           what="flight 2 dispatch")
+p1, p2 = dispatched[e_base], dispatched[e_base + 1]
+# the two flights fly DISJOINT halves
+assert tuple(p1.devs) == ids_lo, p1.devs
+assert tuple(p2.devs) == ids_hi, p2.devs
+wait_until(lambda: plane_p.deck_airborne == 2, what="deck depth 2")
+assert plane_p.stats()["halves"] == 2
+
+# out-of-order landing: flight 2 is released FIRST and must settle
+# while flight 1 is still airborne (no head-of-line blocking)
+release[id(p2)].set()
+verd_w2 = [f.result(30.0) for f in futs_p[E_N // 2:]]
+assert not futs_p[0].done(), "flight 1 settled before its release"
+wait_until(lambda: plane_p.deck_airborne == 1, what="flight 2 landed")
+
+# ---- phase E2: the rotation-window bound on out-of-order landing ----
+# The staging pool rotates flights+1 slots round-robin, so pack 4
+# would hand out the very buffers still pinned under flight 1 (packed
+# as pack 1, still airborne after flight 2 landed out of order). The
+# dispatcher must force-land flight 1 FIFO *before* staging pack 4.
+
+
+def uncounted_wave(tag):
+    rows, vidx = [], []
+    for v in range(0, E_N, 2):
+        m = b"%s-%d" % (tag, v)
+        rows.append((privs[v].pub_key(), m, privs[v].sign(m)))
+        vidx.append(v)
+    return rows, vidx
+
+
+g_e2 = QuorumGroup(1, valset_pubs=pubs_t, valset_powers=powers_t)
+rows3, vidx3 = uncounted_wave(b"w3")
+fut_w3 = plane_p.submit_many(rows3, group=g_e2, counted=False,
+                             vidx=vidx3)
+wait_until(lambda: len(dispatched) == e_base + 3,
+           what="flight 3 dispatch")
+p3 = dispatched[e_base + 2]
+# flight 3 flies the half flight 2 freed; flight 1 (pack 1) is still
+# airborne on the other — within the rotation window, so no force-land
+assert tuple(p3.devs) == ids_hi, p3.devs
+assert not futs_p[0].done()
+rows4, vidx4 = uncounted_wave(b"w4")
+fut_w4 = plane_p.submit_many(rows4, group=g_e2, counted=False,
+                             vidx=vidx4)
+# pack 4 reuses pack 1's buffers: flight 1 must be force-landed (its
+# collect entered) while pack 4 is still UNstaged
+wait_until(lambda: collect_entered[id(p1)].is_set(),
+           what="rotation-window force-land of flight 1")
+assert len(dispatched) == e_base + 3, \
+    "pack 4 staged while flight 1 still pinned its buffers"
+release[id(p1)].set()
+verd_w1 = [f.result(30.0) for f in futs_p[:E_N // 2]]
+wait_until(lambda: len(dispatched) == e_base + 4,
+           what="flight 4 dispatch")
+p4 = dispatched[e_base + 3]
+assert tuple(p4.devs) == ids_lo, p4.devs  # back on the freed half
+release[id(p3)].set()
+release[id(p4)].set()
+assert all(fut_w3.result(30.0)) and all(fut_w4.result(30.0))
+plane_p.stop()
+verd_p = verd_w1 + verd_w2
+assert verd_p == verd_o, "deck verdicts diverged from the oracle"
+assert [g.tally for g in groups_p] == [g.tally for g in groups_o]
+assert [g.quorum_reached for g in groups_p] == \
+    [g.quorum_reached for g in groups_o]
+
+recs_p = plane_p.dump_flushes()["flushes"]
+sh_p = [r for r in recs_p if r["path"] == "fused_sharded"]
+assert len(sh_p) == 4, recs_p
+f1r, f2r, f3r, f4r = sorted(sh_p, key=lambda r: r["seq"])
+# ledger evidence of two flights genuinely airborne on disjoint halves
+assert f1r["airborne"] == 0 and f2r["airborne"] == 1, (f1r, f2r)
+assert (f1r["dev0"], f2r["dev0"]) == (0, 2), (f1r, f2r)
+assert f1r["n_dev"] == 2 and f2r["n_dev"] == 2
+assert all(r["n_host"] == 1 for r in sh_p)
+assert f2r["overlapped"] is True and f1r["overlapped"] is False
+# flights 3/4 each packed with one flight airborne (E2)
+assert (f3r["airborne"], f4r["airborne"]) == (1, 1)
+assert (f3r["dev0"], f4r["dev0"]) == (2, 0)
+# landing order: flight 2 out of order first, then the force-landed
+# flight 1 (rotation window), then 3 and 4
+assert [r["seq"] for r in sh_p] == \
+    [f2r["seq"], f1r["seq"], f3r["seq"], f4r["seq"]], sh_p
+sum_p = plane_p.dump_flushes()["summary"]
+assert sum_p["deck"]["airborne_max"] == 1
+assert sum_p["deck"]["overlapped_flushes"] == 3
+assert plane_p.stats()["deck_peak"] == 2
+
+# ---- phase F: a giant flush takes the FULL mesh and drains first ------
+
+gate_hold["fn"] = None
+f_base = len(dispatched)
+plane_f2 = VerifyPlane(window_ms=30_000.0, max_batch=E_N,
+                       use_device=True, mesh_devices=0, mesh_min_rows=1,
+                       pipeline_flights=2, half_mesh_rows=E_N)
+plane_f2.start()
+groups_f2 = new_groups(THR42)
+subs_f = make_batch(groups_f2)[:E_N]
+futs_f1 = [plane_f2.submit_many(rows, power=pw, group=g, counted=True,
+                                vidx=vidx)
+           for rows, vidx, g, pw, _ in subs_f[:E_N // 2]]
+wait_until(lambda: len(dispatched) == f_base + 1,
+           what="phase F flight 1 dispatch")
+pf1 = dispatched[f_base]
+assert tuple(pf1.devs) == ids_lo
+# one oversized submission: 60 rows > half_mesh_rows (42) -> the
+# policy must take the full mesh and land the airborne deck FIRST
+big_vals = list(range(0, 60, 2))
+big_rows = []
+big_vidx = []
+for v in big_vals:
+    m1, m2 = b"big-%d" % v, b"bigext-%d" % v
+    big_rows += [(privs[v].pub_key(), m1, privs[v].sign(m1)),
+                 (privs[v].pub_key(), m2, privs[v].sign(m2))]
+    big_vidx += [v, v]
+fut_big = plane_f2.submit_many(
+    big_rows, group=QuorumGroup(1, valset_pubs=pubs_t,
+                                valset_powers=powers_t),
+    counted=False, vidx=big_vidx)
+# drain-before-dispatch, observed: the dispatcher enters flight 1's
+# collect (the drain) while the big flush is STILL undispatched
+wait_until(lambda: collect_entered[id(pf1)].is_set(),
+           what="deck drain before the full-mesh dispatch")
+assert len(dispatched) == f_base + 1, \
+    "full-mesh flush dispatched before the deck drained"
+release[id(pf1)].set()
+wait_until(lambda: len(dispatched) == f_base + 2,
+           what="full-mesh dispatch after the drain")
+pf2 = dispatched[f_base + 1]
+assert pf2.drain_first is True
+# NVALS=300 clamps the full mesh to its 2-device prefix — the policy
+# passed over the FREE upper half because the flush was over the knob
+assert tuple(pf2.devs) == ids_lo, pf2.devs
+release[id(pf2)].set()
+assert all(fut_big.result(30.0)), "big-flush verdicts wrong"
+verd_f1 = [f.result(30.0) for f in futs_f1]
+plane_f2.stop()
+assert verd_f1 == exp42[:E_N // 2]
+big_rec = [r for r in plane_f2.dump_flushes()["flushes"]
+           if r["rows"] == len(big_rows)]
+assert big_rec and big_rec[0]["path"] == "fused_sharded"
+assert big_rec[0]["airborne"] == 0  # the deck was drained first
+
+# ---- phase G: breaker trip mid-deck degrades ALL airborne flights -----
+
+g_base = len(dispatched)
+g_hold = threading.Event()
+gate_hold["fn"] = lambda plan: (
+    g_hold.wait(30.0) if len(dispatched) == g_base + 1 else None)
+brk_g = cbatch.CircuitBreaker(failure_threshold=1, cooldown=60.0)
+plane_g = VerifyPlane(window_ms=30_000.0, max_batch=E_N,
+                      use_device=True, mesh_devices=0, mesh_min_rows=1,
+                      pipeline_flights=2, breaker=brk_g)
+plane_g.start()
+groups_g = new_groups(THR42)
+futs_g = drive_waves(
+    plane_g, groups_g,
+    on_wave1=lambda: wait_until(
+        lambda: len(dispatched) == g_base + 1,
+        what="phase G flight 1 dispatch"))
+g_hold.set()  # wave 2 queued: let phase G flight 1's staging finish
+wait_until(lambda: len(dispatched) == g_base + 2,
+           what="phase G flight 2 dispatch")
+wait_until(lambda: plane_g.deck_airborne == 2,
+           what="phase G deck depth 2")
+pg1, pg2 = dispatched[g_base], dispatched[g_base + 1]
+assert set(pg1.devs).isdisjoint(pg2.devs)
+# both collects fault: every airborne flight must degrade to host
+# verdicts (and the breaker must trip)
+fault_ids.update((id(pg1), id(pg2)))
+release[id(pg1)].set()
+release[id(pg2)].set()
+verd_g = [f.result(60.0) for f in futs_g]
+plane_g.stop()
+assert verd_g == exp42, "mid-deck fault changed verdicts"
+assert [g.tally for g in groups_g] == tallies42
+assert brk_g.state == "open", "mid-deck faults must trip the breaker"
+recs_g = plane_g.dump_flushes()["flushes"]
+fb_g = [r for r in recs_g if r["path"] == "fused_host_fallback"]
+assert len(fb_g) == 2, recs_g
+assert all(r["n_dev"] == 1 and r["dev0"] == 0 for r in fb_g)
+
+fz.dispatch_fused = real_dispatch_e
+fz.collect_fused = real_collect_e
+fz.plan_ready = real_ready_e
+
 print(json.dumps({
     "ok": True,
     "devices": len(jax.devices()),
@@ -287,4 +610,13 @@ print(json.dumps({
     "mesh_hits_gained": mesh_after["hits"] - mesh_before["hits"],
     "shard_table_hits_gained":
         tbl_after["shard_hits"] - tbl_before["shard_hits"],
+    "deck": {
+        "halves": [list(ids_lo), list(ids_hi)],
+        "flight_dev0": [f1r["dev0"], f2r["dev0"]],
+        "airborne_max": sum_p["deck"]["airborne_max"],
+        "out_of_order_landing": True,
+        "rotation_window_ok": True,
+        "drain_first_ok": True,
+        "mid_deck_fallbacks": len(fb_g),
+    },
 }))

@@ -319,6 +319,69 @@ def test_staging_pool_concurrent_flushes():
     assert st["resident_bytes"] == n_threads * slots * 16 * 8 * 4
 
 
+def test_staging_pool_depth_tracks_flight_count():
+    """ISSUE 11 satellite: under the flight deck, up to `flights`
+    flushes keep their packed buffers pinned while the NEXT flush
+    packs — the plane must size its private pool flights+1 deep (the
+    old hardcoded 2 aliased the third concurrent pack: pack(k+2) wrote
+    into the buffer flight k was still uploading from). Exact
+    accounting: with depth flights+1, flights+1 outstanding buffers
+    per key never alias and every rotation hit/miss is counted."""
+    import threading
+
+    from cometbft_tpu.libs.staging import StagingPool
+    from cometbft_tpu.verifyplane import VerifyPlane
+
+    # the plane wires the knob straight into its pool depth
+    for flights in (1, 2, 3):
+        plane = VerifyPlane(pipeline_flights=flights)
+        assert plane._staging.slots == flights + 1
+
+    flights, iters, n_threads = 2, 120, 4
+    depth = flights + 1
+    p = StagingPool(slots=depth)
+    errs = []
+    start = threading.Barrier(n_threads)
+
+    def deck_packer(tid):
+        """Hold `depth` buffers outstanding (flights airborne + the
+        pack in progress) and verify none alias within the window."""
+        try:
+            start.wait(5)
+            window = []
+            for i in range(iters):
+                buf = p.get(f"deck.t{tid}", (8, 4), np.int32)
+                if buf.any():
+                    raise AssertionError(f"t{tid} got a dirty buffer")
+                buf[:] = tid * 10_000 + i
+                window.append((buf, tid * 10_000 + i))
+                if len(window) > depth:
+                    window.pop(0)
+                # every buffer still pinned under an airborne flight
+                # must hold ITS flush's rows — an alias would show the
+                # newest pack's pattern in an older flight's buffer
+                for b, pat in window:
+                    if not (b == pat).all():
+                        raise AssertionError(
+                            f"t{tid} airborne buffer overwritten")
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=deck_packer, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    st = p.stats()
+    # exact accounting: per key exactly `depth` allocation misses,
+    # every other get a rotation hit, footprint capped at depth x shape
+    assert st["misses"] == n_threads * depth
+    assert st["hits"] == n_threads * (iters - depth)
+    assert st["resident_bytes"] == n_threads * depth * 8 * 4 * 4
+
+
 def test_staging_pool_exhaustion_aliases_oldest():
     """More outstanding buffers than slots is the documented hazard:
     request slots+1 of one key while all are 'in flight' and the pool

@@ -218,6 +218,42 @@ def test_flush_ledger_deterministic_under_simnet(tmp_path):
     assert all(r["ts_ms"] >= SIM_EPOCH_SECONDS * 1e3 for r in a)
 
 
+def test_flush_ledger_deterministic_with_deck_enabled(tmp_path):
+    """ISSUE 11: the pipelined flight deck must not perturb simnet
+    determinism — the same (seed, schedule) with pipeline_flights=2
+    produces byte-identical ledgers INCLUDING the airborne counts.
+    Host-path flushes are synchronous (the deck only ever holds device
+    flights), so airborne must stay 0 here: a nonzero count would mean
+    the deck's real-clock landing poll leaked onto the simnet path."""
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    def run_once(tag):
+        plane = VerifyPlane(window_ms=0.5, use_device=False,
+                            pipeline_flights=2)
+        plane.start()
+        set_global_plane(plane)
+        try:
+            with Simnet(3, seed=47, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(
+                    [{"at": 0.1, "op": "link", "drop": 0.02,
+                      "delay": 0.01}],
+                    until_height=2, max_time=60.0,
+                )
+                sim.assert_safety()
+        finally:
+            set_global_plane(None)
+            plane.stop()
+        recs = plane.dump_flushes()["flushes"]
+        assert recs, "plane saw no flushes"
+        return recs
+
+    a = run_once("a")
+    b = run_once("b")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert all(r["airborne"] == 0 and r["n_host"] == 1
+               and r["dev0"] == 0 for r in a)
+
+
 def test_light_client_attack_evidence_committed(tmp_path):
     """A >=1/3 coalition's forged header reaches one honest node as
     LightClientAttackEvidence (with its conflicting-commit proof),

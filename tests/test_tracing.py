@@ -192,8 +192,9 @@ def test_queued_ms_ignores_cross_clock_stamps():
     # a simnet-style virtual clock (ns since epoch) lands mid-queue
     tracing.set_clock(lambda: 1_700_000_000_000_000_000)
     try:
-        _, finish, _, _, led = p._stage([sub])
-        verdicts, _ = finish()
+        flight = p._stage([sub])
+        verdicts, _ = flight.finish()
+        led = flight.led
     finally:
         tracing.set_clock(None)
     assert list(verdicts) == [True]
@@ -272,6 +273,49 @@ def test_trace_report_stage_table(tmp_path):
     assert rep["instants"] == {"simnet.op": 1}
     txt = trace_report.format_report(rep)
     assert "plane.pack" in txt and "verify-plane flights: 1" in txt
+
+
+def test_trace_report_deck_occupancy_and_overlap_union():
+    """ISSUE 11 satellite: the overlap/critical-path math must handle
+    MORE than one airborne flight. Two concurrent flights overlapping
+    one pack span used to double-count it (fractions over 1.0); the
+    fix computes pack overlap against the UNION of flight intervals,
+    and the new deck block sweeps concurrency: fraction of wall time
+    with >=1 and >=2 flights airborne."""
+    from tools import trace_report
+
+    # synthetic trace, us timestamps: flight A [0, 100], flight B
+    # [40, 140] (60 us of two-deep deck), one pack span [50, 90]
+    # entirely inside BOTH flights
+    events = [
+        {"ph": "b", "name": "plane.flight", "id": "a", "ts": 0},
+        {"ph": "b", "name": "plane.flight", "id": "b", "ts": 40},
+        {"ph": "X", "name": "plane.pack", "ts": 50, "dur": 40},
+        {"ph": "e", "name": "plane.flight", "id": "a", "ts": 100},
+        {"ph": "e", "name": "plane.flight", "id": "b", "ts": 140},
+    ]
+    rep = trace_report.stage_report(events)
+    p = rep["plane"]
+    assert p["flights"] == 2
+    # union, not per-flight sums: the 40 us pack overlaps ONCE
+    assert p["pack_overlapped_ms"] == pytest.approx(0.04)
+    assert p["pack_overlap_frac"] == pytest.approx(1.0)
+    deck = p["deck"]
+    assert deck["max_airborne"] == 2
+    # >=1 flight over [0, 140] = the whole 140 us wall; >=2 over
+    # [40, 100] = 60 us
+    assert deck["airborne_ge1_ms"] == pytest.approx(0.14)
+    assert deck["airborne_ge2_ms"] == pytest.approx(0.06)
+    assert deck["occupancy_ge1"] == pytest.approx(1.0)
+    assert deck["occupancy_ge2"] == pytest.approx(60 / 140, abs=1e-3)
+    txt = trace_report.format_report(rep)
+    assert "deck occupancy" in txt and "max airborne 2" in txt
+    # the diff's overlap block carries the occupancy deltas
+    diff = trace_report.diff_report(rep, rep)
+    assert diff["overlap"]["occupancy_ge2_a"] == \
+        diff["overlap"]["occupancy_ge2_b"]
+    assert diff["overlap"]["max_airborne_b"] == 2
+    assert not diff["regressions"]
 
 
 def test_trace_report_cli(tmp_path, capsys):

@@ -500,6 +500,75 @@ def test_config_mesh_knobs_roundtrip_and_validation(tmp_path):
         cfg.validate_basic()
 
 
+def test_config_deck_knobs_roundtrip_and_validation(tmp_path):
+    """ISSUE 11: the [verify_plane] flight-deck knobs load/save/
+    validate and reach the plane — pipeline_flights sizes the private
+    staging pool (flights+1 slots) and half_mesh_rows rides along; a
+    host plane has no halves and the deck stays empty."""
+    from cometbft_tpu.config.config import (
+        Config,
+        ConfigError,
+        load_config,
+        save_config,
+    )
+
+    cfg = Config()
+    cfg.verify_plane.enable = True
+    cfg.verify_plane.pipeline_flights = 2
+    cfg.verify_plane.half_mesh_rows = 1024
+    cfg.validate_basic()
+    path = str(tmp_path / "config.toml")
+    save_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.verify_plane.pipeline_flights == 2
+    assert loaded.verify_plane.half_mesh_rows == 1024
+    p = loaded.verify_plane.build()
+    try:
+        assert p.flights == 2
+        assert p.half_mesh_rows == 1024
+        assert p._staging.slots == 3  # flights + 1
+    finally:
+        p.stop()
+    cfg.verify_plane.pipeline_flights = 0
+    with pytest.raises(ConfigError, match="pipeline_flights"):
+        cfg.validate_basic()
+    cfg.verify_plane.pipeline_flights = 1
+    cfg.verify_plane.half_mesh_rows = -1
+    with pytest.raises(ConfigError, match="half_mesh_rows"):
+        cfg.validate_basic()
+
+
+def test_deck_stats_and_ledger_columns_on_host_plane():
+    """Host flushes are synchronous, so the deck never fills — but
+    every surface the TPU deck writes must exist and stay consistent:
+    the ledger's airborne/n_host/dev0 columns (with the legacy
+    overlapped bool derived at read time), the summary deck block, and
+    the stats() deck gauges."""
+    from cometbft_tpu.verifyplane import VerifyPlane
+
+    plane = VerifyPlane(window_ms=0.5, use_device=False,
+                        pipeline_flights=2)
+    plane.start()
+    try:
+        pubs, msgs, sigs, _ = make_rows(4)
+        plane.submit_and_wait(pubs, msgs, sigs)
+    finally:
+        plane.stop()
+    dump = plane.dump_flushes()
+    recs = dump["flushes"]
+    assert recs
+    for r in recs:
+        assert r["airborne"] == 0
+        assert r["overlapped"] is False  # derived legacy bool
+        assert r["n_host"] == 1 and r["dev0"] == 0
+    assert dump["summary"]["deck"] == {"airborne_max": 0,
+                                       "overlapped_flushes": 0}
+    st = plane.stats()
+    assert st["flights"] == 2
+    assert st["deck_airborne"] == 0 and st["deck_peak"] == 0
+    assert st["halves"] == 0
+
+
 def test_ledger_n_dev_column_on_host_flushes(plane):
     """Every flush record carries the device fan-out column; host/
     single-device flushes stamp n_dev=1 and the summary's shard block

@@ -36,13 +36,14 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 5  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 6  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
-                               "cfg2_smoke", "cfg4_smoke", "cfg6_smoke"]
+                               "cfg12_smoke", "cfg2_smoke",
+                               "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -57,6 +58,11 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     sh = results["cfg11_smoke"]["extra"]
     assert sh["ledger_n_dev"] == 1
     assert sh["shard_summary"]["flushes"] == 0
+    # the cfg12 miniature proved the flight-deck plumbing (staging
+    # depth flights+1, deck ledger columns, ready-first picker)
+    dk = results["cfg12_smoke"]["extra"]
+    assert dk["staging_slots"] == 3
+    assert dk["deck_summary"]["airborne_max"] == 0
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

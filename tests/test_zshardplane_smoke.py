@@ -1,12 +1,17 @@
 """Tier-1 coverage for the multichip sharded verify plane (ISSUE 10)
-without TPU hardware: a subprocess forced onto a 4-virtual-device CPU
-mesh runs tests/_shardplane_prog.py, which stubs the two expensive
-device programs (Pallas cached kernel, XLA table build) and drives the
-REAL plane machinery — sharded plan/scatter, per-shard table assembly
-and (valset, mesh) memoization, the psum-tally mesh step, ledger n_dev
+and the pipelined flight deck (ISSUE 11) without TPU hardware: a
+subprocess forced onto a 4-virtual-device CPU mesh runs
+tests/_shardplane_prog.py, which stubs the two expensive device
+programs (Pallas cached kernel, XLA table build) and drives the REAL
+plane machinery — sharded plan/scatter, per-shard table assembly and
+(valset, mesh) memoization, the psum-tally mesh step, ledger n_dev
 attribution, breaker + PlaneOverloaded semantics under a faulting
 sharded dispatch — asserting bit-identical verdicts/tallies/quorum vs
-the single-device oracle.
+the single-device oracle. The deck phases then prove two flights
+genuinely airborne on DISJOINT mesh halves (ledger dev0 0 vs 2 with
+airborne=1), out-of-order landing when flight 2 finishes first, the
+giant-flush drain-the-deck-then-full-mesh policy, and a breaker trip
+mid-deck degrading every airborne flight to correct host verdicts.
 
 Subprocess on purpose (late-alphabet, host-safe shapes): the device
 count must be fixed BEFORE jax initializes, independently of the
@@ -45,3 +50,14 @@ def test_sharded_plane_matches_single_device_on_forced_4dev_host():
     assert rep["sharded_flushes"] >= 2
     assert rep["mesh_hits_gained"] > 0
     assert rep["shard_table_hits_gained"] > 0
+    # ISSUE 11: the flight deck flew two concurrent flushes on
+    # disjoint halves, landed them out of order, drained before a
+    # full-mesh giant flush, and survived a mid-deck breaker trip
+    deck = rep["deck"]
+    assert deck["halves"] == [[0, 1], [2, 3]]
+    assert deck["flight_dev0"] == [0, 2]  # disjoint sub-meshes
+    assert deck["airborne_max"] == 1      # two flights at once
+    assert deck["out_of_order_landing"] is True
+    assert deck["rotation_window_ok"] is True  # staging-slot safety
+    assert deck["drain_first_ok"] is True
+    assert deck["mid_deck_fallbacks"] == 2

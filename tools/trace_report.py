@@ -70,10 +70,49 @@ def _flight_intervals(events: List[dict]) -> List[tuple]:
     return out
 
 
+def _merge_intervals(intervals: List[tuple]) -> List[tuple]:
+    """Union of (lo, hi) intervals as disjoint sorted intervals. The
+    deck keeps several flights airborne at once, so overlap math MUST
+    run against the union — summing raw per-flight overlaps counted
+    the same pack microsecond once per concurrent flight (fractions
+    over 1.0 with two flights airborne)."""
+    out: List[list] = []
+    for lo, hi in sorted(intervals):
+        if out and lo <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return [tuple(p) for p in out]
+
+
 def _overlap_us(span: tuple, intervals: List[tuple]) -> float:
+    """Time `span` spends inside `intervals` — exact only when the
+    intervals are disjoint (pass them through _merge_intervals)."""
     lo, hi = span
     return sum(max(0.0, min(hi, b) - max(lo, a))
                for a, b in intervals if b > lo and a < hi)
+
+
+def _deck_occupancy(intervals: List[tuple]) -> dict:
+    """Concurrency sweep over the flight intervals: how long >=1 and
+    >=2 flights were airborne, and the deepest the deck got — the
+    pipelined-halves instrument (one airborne flight at a time means
+    the deck never overlapped; ge2 time is chips on BOTH halves busy)."""
+    events = sorted([(lo, 1) for lo, hi in intervals]
+                    + [(hi, -1) for lo, hi in intervals])
+    depth = 0
+    ge1 = ge2 = 0.0
+    deepest = 0
+    prev = None
+    for t, d in events:
+        if prev is not None and depth >= 1:
+            ge1 += t - prev
+            if depth >= 2:
+                ge2 += t - prev
+        depth += d
+        deepest = max(deepest, depth)
+        prev = t
+    return {"ge1_us": ge1, "ge2_us": ge2, "max_airborne": deepest}
 
 
 def _consensus_step_durations(events: List[dict]) -> Dict[str, List[float]]:
@@ -114,8 +153,12 @@ def stage_report(events: List[dict]) -> dict:
     stages: per span name, count + total/mean/p50/max ms.
     instants: per instant name, count.
     plane: flush-pipeline extras — flight count/total from the async
-    b/e pairs and the fraction of pack time hidden behind an airborne
-    flight (the double-buffer overlap the dispatcher exists to win).
+    b/e pairs, the fraction of pack time hidden behind an airborne
+    flight (computed against the UNION of flight intervals, so several
+    concurrent deck flights never double-count a pack microsecond),
+    and the deck occupancy sweep: fraction of trace wall time with >=1
+    and >=2 flights airborne (the pipelined-halves instrument — a
+    healthy deck shows ge2 occupancy, not just a boolean overlap).
     fallback: set (with a human note) when the trace holds no
     verify-plane spans and the stage table was derived from the
     consensus-step instants instead.
@@ -123,8 +166,14 @@ def stage_report(events: List[dict]) -> dict:
     spans: Dict[str, List[float]] = {}
     instants: Dict[str, int] = {}
     pack_spans = []
+    t_lo = t_hi = None
     for e in events:
         ph = e.get("ph")
+        ts = e.get("ts")
+        if ts is not None:
+            end = ts + e.get("dur", 0.0)
+            t_lo = ts if t_lo is None else min(t_lo, ts)
+            t_hi = end if t_hi is None else max(t_hi, end)
         if ph == "X":
             spans.setdefault(e["name"], []).append(e.get("dur", 0.0))
             if e["name"] == "plane.pack":
@@ -157,7 +206,13 @@ def stage_report(events: List[dict]) -> dict:
     if flights or pack_spans:
         flight_total = sum(b - a for a, b in flights)
         pack_total = sum(b - a for a, b in pack_spans)
-        overlapped = sum(_overlap_us(p, flights) for p in pack_spans)
+        # union first: with the deck, pack(k+2) can overlap TWO
+        # airborne flights — per-flight sums would count it twice
+        merged = _merge_intervals(flights)
+        overlapped = sum(_overlap_us(p, merged) for p in pack_spans)
+        occ = _deck_occupancy(flights)
+        wall = (t_hi - t_lo) if (t_lo is not None and t_hi > t_lo) \
+            else 0.0
         plane = {
             "flights": len(flights),
             "flight_total_ms": round(flight_total / 1000.0, 3),
@@ -165,6 +220,15 @@ def stage_report(events: List[dict]) -> dict:
             "pack_overlapped_ms": round(overlapped / 1000.0, 3),
             "pack_overlap_frac": round(overlapped / pack_total, 3)
             if pack_total else 0.0,
+            "deck": {
+                "max_airborne": occ["max_airborne"],
+                "airborne_ge1_ms": round(occ["ge1_us"] / 1000.0, 3),
+                "airborne_ge2_ms": round(occ["ge2_us"] / 1000.0, 3),
+                "occupancy_ge1": round(occ["ge1_us"] / wall, 3)
+                if wall else 0.0,
+                "occupancy_ge2": round(occ["ge2_us"] / wall, 3)
+                if wall else 0.0,
+            },
         }
     return {"stages": stages, "instants": instants, "plane": plane,
             "events": len(events), "fallback": fallback}
@@ -239,10 +303,19 @@ def diff_report(rep_a: dict, rep_b: dict,
     if pa or pb:
         fa = (pa or {}).get("pack_overlap_frac", 0.0)
         fb = (pb or {}).get("pack_overlap_frac", 0.0)
+        da = (pa or {}).get("deck") or {}
+        db = (pb or {}).get("deck") or {}
         overlap = {
             "pack_overlap_frac_a": fa,
             "pack_overlap_frac_b": fb,
             "delta": round(fb - fa, 3),
+            # deck occupancy deltas: losing ge2 time means the halves
+            # stopped flying concurrently (informational — the flag
+            # below still keys on pack overlap + flights vanishing)
+            "occupancy_ge2_a": da.get("occupancy_ge2", 0.0),
+            "occupancy_ge2_b": db.get("occupancy_ge2", 0.0),
+            "max_airborne_a": da.get("max_airborne", 0),
+            "max_airborne_b": db.get("max_airborne", 0),
             "flights_a": (pa or {}).get("flights", 0),
             "flights_b": (pb or {}).get("flights", 0),
             "flight_total_ms_a": (pa or {}).get("flight_total_ms", 0.0),
@@ -298,6 +371,13 @@ def format_report(rep: dict) -> str:
                   f"pack {p['pack_total_ms']} ms, "
                   f"{p['pack_overlapped_ms']} ms "
                   f"({p['pack_overlap_frac']:.0%}) hidden behind flights"]
+        d = p.get("deck")
+        if d:
+            lines.append(
+                f"deck occupancy: >=1 flight {d['occupancy_ge1']:.0%} "
+                f"of wall ({d['airborne_ge1_ms']} ms), >=2 flights "
+                f"{d['occupancy_ge2']:.0%} ({d['airborne_ge2_ms']} ms),"
+                f" max airborne {d['max_airborne']}")
     if rep["instants"]:
         lines += ["", "instants: " + ", ".join(
             f"{k}×{v}" for k, v in sorted(rep["instants"].items()))]
@@ -326,6 +406,8 @@ def format_diff(diff: dict, path_a: str = "A", path_b: str = "B") -> str:
                   f"{o['pack_overlap_frac_a']:.3f} -> "
                   f"{o['pack_overlap_frac_b']:.3f} (Δ {o['delta']:+.3f})"
                   f" flights {o['flights_a']}->{o['flights_b']}"
+                  f" deck-ge2 {o['occupancy_ge2_a']:.3f}->"
+                  f"{o['occupancy_ge2_b']:.3f}"
                   + (f"  {o['flag']}" if o["flag"] else "")]
     lines += ["", ("regressions: " + ", ".join(diff["regressions"])
                    if diff["regressions"] else "no regressions flagged")]
