@@ -54,13 +54,29 @@ class KVStoreApplication(abci.Application):
 
     @staticmethod
     def _parse_val_tx(tx: bytes):
-        """val:base64pubkey!power -> (pubkey bytes, power) or None."""
+        """val:base64pubkey!power[!nonce] -> (pubkey bytes, power).
+
+        The optional trailing nonce is ignored by the app but makes
+        repeat rotations of the SAME validator (out at epoch e, back
+        in at e+2, out again at e+5 — routine under committee
+        re-election) produce distinct tx bytes, so the mempool's
+        replay-protection cache can never swallow a later epoch's
+        change as a duplicate of an earlier one."""
         if not tx.startswith(VALIDATOR_PREFIX):
             return None
         try:
             body = tx[len(VALIDATOR_PREFIX):].decode()
-            b64, power = body.split("!", 1)
-            return base64.b64decode(b64), int(power)
+            parts = body.split("!")
+            if len(parts) < 2:
+                raise ValueError("missing power")
+            power = int(parts[1])
+            if power < 0:
+                # update_with_change_set rejects negative power — a
+                # cheap tx must not reach apply_block as a chain-
+                # halting update; reject it at CheckTx/ProcessProposal
+                # like any other malformed val tx
+                raise ValueError("negative power")
+            return base64.b64decode(parts[0]), power
         except Exception:
             raise ValueError(f"malformed validator tx: {tx!r}")
 
@@ -108,7 +124,13 @@ class KVStoreApplication(abci.Application):
         self, req: abci.RequestFinalizeBlock
     ) -> abci.ResponseFinalizeBlock:
         self.staged = dict(self.state)
-        self.val_updates = []
+        # keyed by pubkey, LAST tx wins (the reference kvstore
+        # accumulates ValUpdates in a map too): two rotations of the
+        # same validator landing in one block — out in epoch k, back
+        # in at k+1 — must collapse to ONE update, because
+        # update_with_change_set rejects duplicate addresses and that
+        # rejection would halt the chain on every honest node
+        val_updates: dict = {}
         results = []
         for tx in req.txs:
             if tx.startswith(VALIDATOR_PREFIX):
@@ -119,7 +141,7 @@ class KVStoreApplication(abci.Application):
                 except ValueError as e:
                     results.append(abci.ExecTxResult(code=1, log=str(e)))
                     continue
-                self.val_updates.append(abci.ValidatorUpdate(pub, power))
+                val_updates[pub] = abci.ValidatorUpdate(pub, power)
                 results.append(abci.ExecTxResult())
                 continue
             if b"=" in tx:
@@ -128,6 +150,7 @@ class KVStoreApplication(abci.Application):
                 k = v = tx
             self.staged[k] = v
             results.append(abci.ExecTxResult(data=v))
+        self.val_updates = list(val_updates.values())
         self._pending_height = req.height
         self._pending_hash = self._computed_staged_hash(req.height)
         return abci.ResponseFinalizeBlock(

@@ -121,10 +121,32 @@ class CryptoConfig:
     # often an open breaker re-probes the device
     breaker_failure_threshold: int = 2
     breaker_cooldown: float = 30.0
+    # bounded valset-table caches (ops/table_cache.py): how many built
+    # window tables / sharded table sets / identity-memo entries stay
+    # resident across epoch rotations. Each retired epoch's table is
+    # LRU-evictable dead weight; these bound it (min 2 per cache — a
+    # next-epoch warm insert must never evict the LIVE table).
+    # table_cache_stats()/resident_bytes ride /metrics at scrape time.
+    table_cache_tables: int = 8
+    table_cache_shard_tables: int = 4
+    table_cache_memo_entries: int = 8
+
+    def apply_table_cache(self) -> None:
+        """Push the cache capacities into the (jax-free) table-cache
+        core; safe to call before any device module loads."""
+        from cometbft_tpu.ops import table_cache as tcache
+
+        tcache.set_capacities(
+            tables=self.table_cache_tables,
+            shard_tables=self.table_cache_shard_tables,
+            key_memo=self.table_cache_memo_entries * 2,
+            valset_memo=self.table_cache_memo_entries,
+        )
 
     def batch_fn(self):
         from cometbft_tpu.crypto import batch as cbatch
 
+        self.apply_table_cache()
         cbatch.configure_breaker(self.breaker_failure_threshold,
                                  self.breaker_cooldown)
         if self.verifier == "cpu":
@@ -183,6 +205,14 @@ class VerifyPlaneConfig:
     # cap takes the full mesh and drains the deck first.
     pipeline_flights: int = 1
     half_mesh_rows: int = 0
+    # Next-epoch table warmer (verifyplane/warmer.py): when the block
+    # executor applies validator updates, a background thread builds
+    # the epoch e+1 valset's window tables (sharded too, when a mesh
+    # is configured) while epoch e is still live — the first commit
+    # after a rotation then hits a warm cache instead of paying the
+    # build inline. Pure optimization: warmer faults/skips degrade to
+    # the cold path and never touch live verdicts.
+    warm_next_epoch: bool = True
 
     def build(self, metrics=None):
         """A VerifyPlane per this config, or None when disabled."""
@@ -205,6 +235,15 @@ class VerifyPlaneConfig:
             pipeline_flights=self.pipeline_flights,
             half_mesh_rows=self.half_mesh_rows,
         )
+
+    def build_warmer(self):
+        """The next-epoch TableWarmer, or None when the plane or the
+        warm_next_epoch knob is off."""
+        if not (self.enable and self.warm_next_epoch):
+            return None
+        from cometbft_tpu.verifyplane.warmer import TableWarmer
+
+        return TableWarmer()
 
 
 @dataclass
@@ -308,6 +347,21 @@ class Config:
             )
         if self.crypto.breaker_cooldown < 0:
             raise ConfigError("[crypto] breaker_cooldown must be >= 0")
+        for name in ("table_cache_tables", "table_cache_shard_tables",
+                     "table_cache_memo_entries"):
+            if getattr(self.crypto, name) < 2:
+                raise ConfigError(
+                    f"[crypto] {name} must be >= 2 — capacity 1 would "
+                    f"let a next-epoch warm insert evict the LIVE "
+                    f"epoch's table mid-flush")
+        if self.verify_plane.pipeline_flights > 1 \
+                and self.crypto.table_cache_shard_tables < 4:
+            raise ConfigError(
+                "[crypto] table_cache_shard_tables must be >= 4 with "
+                "[verify_plane] pipeline_flights > 1 — the deck keeps "
+                "a LIVE sharded table per mesh half (two), so a "
+                "next-epoch warm of both halves needs headroom or it "
+                "evicts a live half's table mid-flush")
         if self.verify_plane.window_ms < 0:
             raise ConfigError("[verify_plane] window_ms must be >= 0")
         if self.verify_plane.max_batch < 1:

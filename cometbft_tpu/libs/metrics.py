@@ -381,6 +381,23 @@ class NodeMetrics:
             "crypto", "valset_table_cache_total",
             "Device-resident valset table cache events "
             "(ops.ed25519_cached.table_cache_stats, kind-labeled)")
+        self.table_cache_evictions = r.counter(
+            "crypto", "table_cache_evictions_total",
+            "Entries the bounded valset-table caches dropped under "
+            "epoch-churn pressure (kind=tables|shard|valset_memo|"
+            "key_memo — ops/table_cache.py LRU eviction counts)")
+        self.table_cache_resident = r.gauge(
+            "crypto", "table_cache_resident_bytes",
+            "Host+device bytes pinned by the bounded valset-table "
+            "caches (epoch churn must hold this flat)")
+        self.warmer_builds = r.counter(
+            "verifyplane", "valset_warmer_builds_total",
+            "Next-epoch table warmer build outcomes "
+            "(outcome=ok|failed|skipped|superseded)")
+        self.warmer_hits = r.counter(
+            "verifyplane", "valset_warmer_hits_total",
+            "Table lookups answered by a warmer-prebuilt table (the "
+            "first commit after a rotation, when the warm won)")
         self.mesh_step_cache = r.counter(
             "parallel", "mesh_step_cache_total",
             "Memoized sharded-step builder cache events "
@@ -491,11 +508,36 @@ class NodeMetrics:
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:
-            ec = sys.modules.get("cometbft_tpu.ops.ed25519_cached")
-            if ec is not None:
-                for kind, v in ec.table_cache_stats().items():
+            # the table-cache core is jax-free (ops/table_cache.py), so
+            # sampling it never risks a cold jax import; eviction kinds
+            # and warm-attribution land in their own families
+            from cometbft_tpu.ops import table_cache as tcache
+
+            for kind, v in tcache.stats().items():
+                if kind.startswith("evictions_"):
+                    self.table_cache_evictions._set(
+                        (("kind", kind[len("evictions_"):]),), float(v))
+                elif kind == "warmed_hits":
+                    self.warmer_hits._set((), float(v))
+                else:
                     self.valset_table_cache._set((("kind", kind),),
                                                  float(v))
+            self.table_cache_resident.set(
+                float(tcache.resident_bytes()))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            wm = sys.modules.get("cometbft_tpu.verifyplane.warmer")
+            w = wm and wm.last_warmer()
+            if w is not None:
+                st = w.stats()
+                for outcome in ("ok", "failed", "skipped"):
+                    self.warmer_builds._set(
+                        (("outcome", outcome),),
+                        float(st["builds_" + outcome]))
+                self.warmer_builds._set(
+                    (("outcome", "superseded"),),
+                    float(st["superseded"]))
         except Exception:  # noqa: BLE001
             pass
         try:

@@ -186,10 +186,18 @@ class Node(BaseService):
         # Started with the node; registered as THE global plane so every
         # verification consumer in-process coalesces through it.
         self.verify_plane = None
+        # next-epoch table warmer ([verify_plane] warm_next_epoch):
+        # builds the epoch e+1 valset's device window tables in the
+        # background when a block's validator updates rotate the set,
+        # so the first post-rotation commit verifies against a warm
+        # cache (verifyplane/warmer.py). Lifecycle rides the plane's.
+        self.valset_warmer = None
         if verify_plane is not None:
             if hasattr(verify_plane, "build"):
                 self.verify_plane = verify_plane.build(
                     metrics=self.metrics)
+                if hasattr(verify_plane, "build_warmer"):
+                    self.valset_warmer = verify_plane.build_warmer()
             else:
                 self.verify_plane = verify_plane
                 if self.verify_plane.metrics is None:
@@ -376,6 +384,13 @@ class Node(BaseService):
                          else "requested but <2 devices; "
                               "single-device")
                       + deck)
+        if self.valset_warmer is not None:
+            # after the plane: a warm build may shard over the plane's
+            # freshly-resolved mesh
+            from cometbft_tpu.verifyplane import warmer as vp_warmer
+
+            self.valset_warmer.start()
+            vp_warmer.set_global_warmer(self.valset_warmer)
         if self.lightgate is not None:
             # after the plane: the gateway's batch_fn rides its GATEWAY
             # lane from the first request
@@ -448,6 +463,13 @@ class Node(BaseService):
             # before the plane stops: in-flight gateway verifies fall
             # back to the direct host path instead of racing the drain
             self.lightgate.stop()
+        if self.valset_warmer is not None:
+            # before the plane: a mid-warm sharded build may still be
+            # using the plane's mesh; stop() abandons it cleanly
+            from cometbft_tpu.verifyplane import warmer as vp_warmer
+
+            vp_warmer.clear_global_warmer(self.valset_warmer)
+            self.valset_warmer.stop()
         if self.verify_plane is not None:
             from cometbft_tpu import verifyplane
 

@@ -47,9 +47,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
-import threading
-from collections import OrderedDict
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +56,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import table_cache as tc
 from cometbft_tpu.ops import curve25519 as curve
 from cometbft_tpu.ops import ed25519_kernel as ek
 from cometbft_tpu.ops.field import F25519, NLIMBS
@@ -388,29 +387,41 @@ def update_table(table: ValsetTable, changes,
     return ValsetTable(tab, ok, power5, table.n_vals, pubs_host, ph)
 
 
-# LRU of built tables keyed by the pubkey list (order-sensitive: the
-# validator INDEX is the gather key). Commit verification presents the
-# same valset in the same order every block, so this hits ~always; on
-# a miss, a cached table for a near-identical list (epoch churn) is
-# updated incrementally instead of rebuilt.
-_TABLE_CACHE: "OrderedDict[bytes, ValsetTable]" = OrderedDict()
-_TABLE_CACHE_MAX = 8
-_TABLE_LOCK = threading.Lock()
+# The whole cache stack below (built tables, sharded tables, the two
+# identity memos) is BOUNDED and EVICTING: instances, capacities,
+# eviction/warm accounting, and the shared lock live in the jax-free
+# cometbft_tpu.ops.table_cache — epoch churn retires one valset per
+# epoch and the retired epochs' tables must not accumulate forever
+# (ROADMAP item 5). This module wires the kernel-side lookups through
+# those caches.
+#
+# _TABLE_CACHE: LRU of built tables keyed by the pubkey list
+# (order-sensitive: the validator INDEX is the gather key). Commit
+# verification presents the same valset in the same order every block,
+# so this hits ~always; on a miss, a cached table for a near-identical
+# list (epoch churn) is updated incrementally instead of rebuilt.
+_TABLE_CACHE = tc.TABLES
+_TABLE_LOCK = tc.LOCK
+_TABLE_STATS = tc.STATS
 MAX_INCREMENTAL = 64  # fall back to full rebuild above this delta
 
-# steady-state observability + the zero-copy hot path's regression
-# guard: a healthy consensus stream should be ~all hits (the shard_*
-# kinds count the per-mesh sharded-table cache the multichip verify
-# plane rides — steady-state sharded flushes must be all shard_hits,
-# i.e. zero table re-uploads)
-_TABLE_STATS = {"hits": 0, "misses": 0, "key_memo_hits": 0,
-                "valset_hits": 0, "valset_misses": 0,
-                "shard_hits": 0, "shard_misses": 0}
+note_warmed = tc.note_warmed  # the warmer's attribution seam
 
 
 def table_cache_stats() -> dict:
-    with _TABLE_LOCK:
-        return dict(_TABLE_STATS)
+    """Steady-state observability + the zero-copy hot path's regression
+    guard: a healthy consensus stream should be ~all hits. shard_* count
+    the per-mesh sharded-table cache the multichip verify plane rides
+    (steady-state sharded flushes must be all shard_hits — zero table
+    re-uploads); evictions_* count churn-pressure drops per bounded
+    cache; warmed_hits count lookups the next-epoch warmer pre-built."""
+    return tc.stats()
+
+
+def table_cache_resident_bytes() -> int:
+    """Bytes pinned by the (bounded) table caches — the figure epoch
+    churn must hold flat; /metrics samples it at scrape time."""
+    return tc.resident_bytes()
 
 
 def _cache_key(pub_bytes: Sequence[bytes], powers) -> bytes:
@@ -432,9 +443,9 @@ def _cache_key(pub_bytes: Sequence[bytes], powers) -> bytes:
 # Callers that present a stable immutable key list (QuorumGroup's
 # valset_pubs tuple, StreamVerifier's per-valset columns) pay it once.
 # Entries pin the tuples themselves, so an id() can never alias a
-# collected object.
-_KEY_MEMO: "OrderedDict[int, tuple]" = OrderedDict()
-_KEY_MEMO_MAX = 16
+# collected object — and the cache is bounded (tc.KEY_MEMO), so
+# retired epochs' QuorumGroup tuples stop accumulating.
+_KEY_MEMO = tc.KEY_MEMO
 
 
 def _memo_cache_key(pub_bytes, powers) -> bytes:
@@ -445,26 +456,27 @@ def _memo_cache_key(pub_bytes, powers) -> bytes:
     with _TABLE_LOCK:
         ent = _KEY_MEMO.get(id(pub_bytes))
         if ent is not None and ent[0] is pub_bytes and ent[1] is powers:
-            _KEY_MEMO.move_to_end(id(pub_bytes))
             _TABLE_STATS["key_memo_hits"] += 1
             return ent[2]
     key = _cache_key(pub_bytes, powers)
     with _TABLE_LOCK:
-        _KEY_MEMO[id(pub_bytes)] = (pub_bytes, powers, key)
-        while len(_KEY_MEMO) > _KEY_MEMO_MAX:
-            _KEY_MEMO.popitem(last=False)
+        _KEY_MEMO.put(id(pub_bytes), (pub_bytes, powers, key))
     return key
 
 
-def table_for_pubs(pub_bytes: Sequence[bytes],
-                   powers=None) -> ValsetTable:
+def table_for_pubs_info(pub_bytes: Sequence[bytes],
+                        powers=None) -> Tuple[ValsetTable, bool]:
+    """(table, warm): warm=True when the lookup was a straight LRU hit
+    — no build and no incremental patch. The verify plane stamps this
+    into the flush ledger's `warm` column so /dump_flushes attributes
+    a post-rotation stall to the cold table build it actually paid."""
     key = _memo_cache_key(pub_bytes, powers)
     with _TABLE_LOCK:
         t = _TABLE_CACHE.get(key)
         if t is not None:
-            _TABLE_CACHE.move_to_end(key)
             _TABLE_STATS["hits"] += 1
-            return t
+            tc.consume_warmed(key)
+            return t, True
         _TABLE_STATS["misses"] += 1
         # near-miss scan: same padded size, few changed slots -> update
         # the cached table incrementally (valset churn between epochs).
@@ -473,7 +485,7 @@ def table_for_pubs(pub_bytes: Sequence[bytes],
         base = None
         padded = table_pad(len(pub_bytes))
         target = _pubs_host(pub_bytes, padded)
-        for cand in reversed(_TABLE_CACHE.values()):
+        for cand in reversed(list(_TABLE_CACHE.values())):
             if cand.n_vals != padded or cand.pubs_host is None:
                 continue
             diff = [i for i in range(padded)
@@ -503,10 +515,13 @@ def table_for_pubs(pub_bytes: Sequence[bytes],
     if t is None:
         t = build_table(pub_bytes, powers)
     with _TABLE_LOCK:
-        _TABLE_CACHE[key] = t
-        while len(_TABLE_CACHE) > _TABLE_CACHE_MAX:
-            _TABLE_CACHE.popitem(last=False)
-    return t
+        _TABLE_CACHE.put(key, t)
+    return t, False
+
+
+def table_for_pubs(pub_bytes: Sequence[bytes],
+                   powers=None) -> ValsetTable:
+    return table_for_pubs_info(pub_bytes, powers)[0]
 
 
 # Device-resident per-valset front cache: consensus and blocksync hold
@@ -516,9 +531,9 @@ def table_for_pubs(pub_bytes: Sequence[bytes],
 # let alone re-uploads it. Entries pin the set AND its validators list:
 # update_with_change_set replaces the list wholesale, so a mutated set
 # can never serve a stale table (the priority-only mutations of
-# proposer rotation don't touch keys or powers).
-_VALSET_MEMO: "OrderedDict[int, tuple]" = OrderedDict()
-_VALSET_MEMO_MAX = 8
+# proposer rotation don't touch keys or powers) — and a ROTATED set's
+# old entry becomes evictable dead weight the bounded cache drops.
+_VALSET_MEMO = tc.VALSET_MEMO
 
 
 def table_for_valset(vals) -> ValsetTable:
@@ -529,7 +544,6 @@ def table_for_valset(vals) -> ValsetTable:
         ent = _VALSET_MEMO.get(id(vals))
         if ent is not None and ent[0] is vals \
                 and ent[1] is vals.validators:
-            _VALSET_MEMO.move_to_end(id(vals))
             _TABLE_STATS["valset_hits"] += 1
             return ent[2]
     pubs = tuple(v.pub_key.data for v in vals.validators)
@@ -537,9 +551,7 @@ def table_for_valset(vals) -> ValsetTable:
     t = table_for_pubs(pubs, powers)
     with _TABLE_LOCK:
         _TABLE_STATS["valset_misses"] += 1
-        _VALSET_MEMO[id(vals)] = (vals, vals.validators, t)
-        while len(_VALSET_MEMO) > _VALSET_MEMO_MAX:
-            _VALSET_MEMO.popitem(last=False)
+        _VALSET_MEMO.put(id(vals), (vals, vals.validators, t))
     return t
 
 
@@ -578,29 +590,34 @@ def shard_stride(n_vals: int, n_dev: int) -> int:
     return table_pad(-(-max(n_vals, 1) // max(n_dev, 1)))
 
 
-# (content key, mesh identity) -> ShardedValsetTable. Small: a node
-# serves one live valset per mesh in the steady state; churn evicts.
-_SHARD_CACHE: "OrderedDict[tuple, ShardedValsetTable]" = OrderedDict()
-_SHARD_CACHE_MAX = 4
+# (content key, mesh identity) -> ShardedValsetTable. Small and
+# BOUNDED (tc.SHARDS): a node serves one live valset per mesh in the
+# steady state; churn evicts the retired epochs' shard sets.
+_SHARD_CACHE = tc.SHARDS
 
 
-def sharded_table_for_pubs(pub_bytes: Sequence[bytes], powers,
-                           mesh) -> ShardedValsetTable:
+def sharded_table_for_pubs_info(pub_bytes: Sequence[bytes], powers,
+                                mesh) -> Tuple[ShardedValsetTable, bool]:
     """The per-shard device-resident window table for (valset, mesh),
     memoized like table_for_pubs: the content key rides the same
     identity memo (_memo_cache_key — QuorumGroup's immutable tuples
     pay the O(valset) digest once), so a steady-state sharded flush
     uploads NOTHING. Accounting lands in table_cache_stats() under
-    the shard_hits/shard_misses kinds."""
+    the shard_hits/shard_misses kinds. Returns (table, warm) like
+    table_for_pubs_info (warm=True = straight cache hit)."""
     from cometbft_tpu.parallel import mesh as pm
 
     key = (_memo_cache_key(pub_bytes, powers), pm._mesh_key(mesh))
     with _TABLE_LOCK:
         t = _SHARD_CACHE.get(key)
         if t is not None:
-            _SHARD_CACHE.move_to_end(key)
             _TABLE_STATS["shard_hits"] += 1
-            return t
+            # the warmer marks sharded builds distinctly from plain
+            # ones AND per mesh (the deck's two halves warm two
+            # tables; each half's first post-rotation flush must
+            # attribute its own hit)
+            tc.consume_warmed((key[0], "shard", key[1]))
+            return t, True
         _TABLE_STATS["shard_misses"] += 1
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -639,10 +656,13 @@ def sharded_table_for_pubs(pub_bytes: Sequence[bytes], powers,
         m_s, n_dev,
     )
     with _TABLE_LOCK:
-        _SHARD_CACHE[key] = t
-        while len(_SHARD_CACHE) > _SHARD_CACHE_MAX:
-            _SHARD_CACHE.popitem(last=False)
-    return t
+        _SHARD_CACHE.put(key, t)
+    return t, False
+
+
+def sharded_table_for_pubs(pub_bytes: Sequence[bytes], powers,
+                           mesh) -> ShardedValsetTable:
+    return sharded_table_for_pubs_info(pub_bytes, powers, mesh)[0]
 
 
 # --------------------------------------------------------------------------

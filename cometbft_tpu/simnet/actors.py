@@ -37,6 +37,59 @@ from cometbft_tpu.types.timestamp import Timestamp
 from cometbft_tpu.types.vote import Vote
 
 
+def election_score(seed: int, epoch: int, pub: bytes, stake: int) -> float:
+    """Deterministic stake-weighted sampling key (Efraimidis–Spirakis
+    A-Res): u^(1/stake) with u drawn from a hash of (seed, epoch, pub).
+    Ranking the pool by this key descending IS a proportional weighted
+    sample without replacement — a member's selection probability is
+    proportional to its stake, the committee-election property the
+    proportional rule of arXiv 2004.12990 targets. Pure function of its
+    arguments: the same (seed, schedule) elects the same committees in
+    every replay."""
+    h = hashlib.sha256(
+        b"simnet-election" + seed.to_bytes(8, "big", signed=True)
+        + epoch.to_bytes(8, "big") + pub
+    ).digest()
+    u = (int.from_bytes(h[:8], "big") + 1) / float(2 ** 64 + 1)
+    return u ** (1.0 / max(1, int(stake)))
+
+
+def proportional_election(seed: int, epoch: int, committee, standby,
+                          stakes, churn: float):
+    """One epoch of deterministic proportional committee election with
+    bounded churn.
+
+    committee / standby: disjoint lists of pool member indices;
+    stakes: {index: (pub_bytes, stake)} — scores key on the member's
+    PUBKEY so an index renumbering can never re-seed the draw; churn:
+    fraction of the committee re-elected this epoch. The K = round(churn * size) sitting members
+    with the WORST stake-weighted score this epoch rotate out and the
+    K best-scoring standby members rotate in (so every seat turnover is
+    itself a proportional draw). Returns (new_committee, new_standby,
+    rotated_out, rotated_in) — all index lists, sorted for determinism.
+
+    This is the election half of the simnet epoch driver; the harness
+    turns the rotation into kvstore ``val:`` txs so the change set
+    flows through the REAL ABCI -> update_with_change_set ->
+    state/execution.py pipeline on every node."""
+    committee = sorted(int(i) for i in committee)
+    standby = sorted(int(i) for i in standby)
+    size = len(committee)
+    k = min(int(round(max(0.0, float(churn)) * size)), size,
+            len(standby))
+    if k == 0 or not committee:
+        return committee, standby, [], []
+
+    def score(i: int) -> float:
+        return election_score(seed, epoch, stakes[i][0], stakes[i][1])
+
+    out = sorted(sorted(committee, key=score)[:k])
+    inn = sorted(sorted(standby, key=score, reverse=True)[:k])
+    new_committee = sorted(set(committee) - set(out) | set(inn))
+    new_standby = sorted(set(standby) - set(inn) | set(out))
+    return new_committee, new_standby, out, inn
+
+
 def _fake_block_id(tag: bytes) -> BlockID:
     h = hashlib.sha256(b"simnet-byzantine-" + tag).digest()
     return BlockID(h, PartSetHeader(1, h))

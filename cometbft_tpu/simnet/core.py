@@ -407,16 +407,32 @@ class SimNode:
 
 
 class SimNetwork:
-    """The hub: event queue, links, clock, and N SimNodes."""
+    """The hub: event queue, links, clock, and N SimNodes.
+
+    Epoch-scale churn (`extra_validators` > 0): beyond the N running
+    node-validators, the network carries a deterministic POOL of
+    passive tail validators — pubkey-only members (hash-derived 32-byte
+    keys; they never vote, so no curve math is ever paid for them) with
+    stake weights. A proportional election (simnet/actors.py, the
+    arXiv 2004.12990 rule) seats `committee_size` of them at genesis
+    and the ``epoch`` schedule op re-elects K% of that committee per
+    epoch through kvstore ``val:`` txs — i.e. through the REAL
+    ABCI -> update_with_change_set -> state/execution.py rotation
+    path on every node. Node-validators hold a supermajority of power
+    by construction (checked at init), so the passive tail can churn
+    freely without wedging quorum — exactly the production shape where
+    a handful of big operators stay while the long tail re-elects."""
 
     def __init__(self, n_nodes: int, seed: int, basedir: str,
                  app_factory=None, timeouts=None, chain_id: str = "simnet",
-                 power: int = 10):
+                 power: int = 10, extra_validators: int = 0,
+                 committee_size: Optional[int] = None):
+        import hashlib
         import os
 
         from cometbft_tpu.abci.kvstore import KVStoreApplication
         from cometbft_tpu.consensus.ticker import TimeoutParams
-        from cometbft_tpu.crypto.keys import PrivKey
+        from cometbft_tpu.crypto.keys import PrivKey, PubKey
         from cometbft_tpu.state.state import State
         from cometbft_tpu.types.validator import Validator, ValidatorSet
 
@@ -445,8 +461,53 @@ class SimNetwork:
             )
             for i in range(n_nodes)
         ]
-        vals = ValidatorSet([Validator(p.pub_key(), power)
-                             for p in self.privs])
+        val_list = [Validator(p.pub_key(), power) for p in self.privs]
+        # passive tail pool + proportional genesis committee (the
+        # epoch-rotation surface; see the class docstring)
+        self.tail_pubs: List[bytes] = []
+        self.tail_stakes: Dict[int, tuple] = {}
+        self.epoch_state: Optional[Dict] = None
+        if extra_validators > 0:
+            from cometbft_tpu.simnet import actors
+
+            self.tail_pubs = [
+                hashlib.sha256(
+                    b"simnet-tail-%d-%d" % (seed % 2**32, i)
+                ).digest()
+                for i in range(extra_validators)
+            ]
+            self.tail_stakes = {
+                i: (self.tail_pubs[i], 1 + i % 7)
+                for i in range(extra_validators)
+            }
+            size = min(committee_size or max(1, extra_validators // 2),
+                       extra_validators)
+            total_stake = sum(s for _, s in self.tail_stakes.values())
+            if n_nodes * power <= 2 * total_stake:
+                raise ValueError(
+                    f"node power {n_nodes}x{power} must exceed 2x the "
+                    f"tail stake total {total_stake}: the passive tail "
+                    f"never votes, so it must never hold a blocking "
+                    f"1/3 — raise `power` (the churn tests use 10^5+)"
+                )
+            ranked = sorted(
+                range(extra_validators),
+                key=lambda i: actors.election_score(
+                    seed, 0, *self.tail_stakes[i]),
+                reverse=True,
+            )
+            committee = sorted(ranked[:size])
+            self.epoch_state = {
+                "epoch": 0, "size": size,
+                "committee": committee,
+                "standby": sorted(ranked[size:]),
+            }
+            val_list += [
+                Validator(PubKey(self.tail_pubs[i], "ed25519"),
+                          self.tail_stakes[i][1])
+                for i in committee
+            ]
+        vals = ValidatorSet(val_list)
         self.genesis = State.make_genesis(
             chain_id, vals, genesis_time=Timestamp(SIM_EPOCH_SECONDS, 0),
         )

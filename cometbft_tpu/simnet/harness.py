@@ -72,6 +72,10 @@ class Simnet:
         # forged-header scenario asserts honest clients complete and
         # the whole verdict stream replays byte-identically
         self.gateway_results: List[Dict] = []
+        # every epoch op's election outcome (who rotated out/in, how
+        # many val txs were injected) — the churn soak asserts the
+        # rotation stream replays byte-identically
+        self.epoch_results: List[Dict] = []
         # flush-ledger position at sim start: failure blobs attach the
         # ledger tail only if it advanced during THIS simulation
         from cometbft_tpu import verifyplane
@@ -185,6 +189,8 @@ class Simnet:
                 node.node.mempool.check_tx(bytes.fromhex(op["data"]))
         elif kind == "flood":
             self._launch_flood(op)
+        elif kind == "epoch":
+            self._launch_epoch(op)
 
     # flood txs are signed with ONE deterministic throwaway key (a
     # function of nothing but this constant), so the same (seed,
@@ -231,6 +237,69 @@ class Simnet:
             tx = sigtx.wrap(priv, payload) if signed else payload
             net.schedule(k / rate, lambda k=k, tx=tx: inject(k, tx),
                          f"flood n{idx}")
+
+    def _launch_epoch(self, op: Dict) -> None:
+        """One epoch of proportional committee re-election over the
+        network's passive validator tail. The deterministic election
+        (actors.proportional_election, a pure function of (seed, epoch
+        index, committee)) picks who rotates; the change set becomes
+        kvstore ``val:`` txs injected into EVERY alive node's mempool
+        (simnet mempools don't gossip, and whichever node proposes next
+        must carry the rotation), flowing through the real
+        ABCI validator-update -> update_with_change_set ->
+        state/execution.py path — the valset rotates at H+2 and
+        commits stay byte-identical across replays."""
+        import base64
+
+        net = self.net
+        rec: Dict = {"seq": len(self.epoch_results), "at": net.now}
+        st = net.epoch_state
+        if st is None:
+            rec["error"] = ("no validator tail pool — build the "
+                            "Simnet with extra_validators > 0")
+            self.epoch_results.append(rec)
+            return
+        st["epoch"] += 1
+        churn = float(op.get("churn", 0.25))
+        committee, standby, out, inn = actors.proportional_election(
+            net.seed, st["epoch"], st["committee"], st["standby"],
+            net.tail_stakes, churn,
+        )
+        st["committee"], st["standby"] = committee, standby
+        # the !e<epoch> nonce keeps repeat rotations of one validator
+        # byte-distinct, so mempool replay protection can't swallow a
+        # later epoch's change as a dup of an earlier one
+        nonce = b"!e%d" % st["epoch"]
+        txs = [b"val:" + base64.b64encode(net.tail_pubs[i]) + b"!0"
+               + nonce for i in out]
+        txs += [b"val:" + base64.b64encode(net.tail_pubs[i])
+                + b"!%d" % net.tail_stakes[i][1] + nonce for i in inn]
+        # the named node's verdicts ride the record; a dead target
+        # falls to the next alive index (deterministic, so the replay
+        # stream is too) — rotation-while-killed must still rotate
+        codes: List = []
+        target = int(op["node"])
+        alive = [n.idx for n in net.nodes if n.alive]
+        rec_idx = next((i for i in alive if i >= target),
+                       alive[0] if alive else None)
+        for node in net.nodes:
+            if not node.alive:
+                continue
+            with net._node_scope(node):
+                for tx in txs:
+                    try:
+                        r = node.node.mempool.check_tx(tx)
+                        code = getattr(r, "code", 0)
+                    except Exception as e:  # noqa: BLE001 - recorded
+                        code = repr(e)[:80]
+                    if node.idx == rec_idx:
+                        codes.append(code)
+            net._pump(node)
+        rec.update({"epoch": st["epoch"], "churn": churn,
+                    "out": list(out), "in": list(inn),
+                    "txs": len(txs), "codes": codes,
+                    "committee_size": len(committee)})
+        self.epoch_results.append(rec)
 
     def _launch_gateway_sync(self, op: Dict) -> None:
         """Mount a light-client gateway on the target node and drive K

@@ -43,6 +43,19 @@ Op catalog (each op is a plain dict, `at` in simulated seconds):
       LightClientAttackEvidence through the node's evidence pool, and
       keep serving the honest clients. Every verdict is recorded on
       Simnet.gateway_results (replay-assertable).
+  {"at": t, "op": "epoch", "node": i, "churn": k}
+      One epoch of proportional committee re-election over the
+      network's passive validator tail (SimNetwork extra_validators):
+      the deterministic election (simnet/actors.proportional_election,
+      seeded by (seed, epoch index)) rotates churn*committee_size
+      members out/in, and the change set is injected as kvstore
+      ``val:`` txs into every alive node's mempool starting at node i
+      — so the rotation flows through the REAL ABCI validator-update
+      -> ValidatorSet.update_with_change_set -> state/execution.py
+      path and lands in the valset at H+2. Election outcomes are
+      recorded on Simnet.epoch_results (replay-assertable); a network
+      built without a tail records an error instead of perturbing
+      nothing silently.
   {"at": t, "op": "flood", "node": i, "rate": txs_per_sim_second,
    "duration": s, "signed": bool, "size": payload_bytes}
       Open-loop sustained tx stream into node i's broadcast_tx path:
@@ -60,7 +73,7 @@ from typing import Dict, List
 
 OPS = ("partition", "heal", "link", "kill", "restart", "failpoint",
        "equivocate", "garbage", "light_attack", "gateway_sync", "tx",
-       "flood")
+       "flood", "epoch")
 
 _LINK_KEYS = ("drop", "delay", "jitter", "dup", "reorder")
 
@@ -98,9 +111,15 @@ def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
         # selector otherwise validates fine and KeyErrors mid-simulation
         # (a replay-blob failure instead of this loud ScheduleError)
         if kind in ("kill", "restart", "failpoint", "equivocate",
-                    "garbage", "tx", "flood", "gateway_sync") \
+                    "garbage", "tx", "flood", "gateway_sync",
+                    "epoch") \
                 and "node" not in op:
             raise ScheduleError(f"{kind} requires a node in {op!r}")
+        if kind == "epoch":
+            churn = float(op.get("churn", 0.25))
+            if not 0.0 < churn <= 1.0:
+                raise ScheduleError(
+                    f"epoch churn must be in (0, 1] in {op!r}")
         if kind == "gateway_sync":
             if int(op.get("clients", 0)) < 1:
                 raise ScheduleError(
@@ -155,20 +174,24 @@ def schedule_from_json(blob: str):
 
 
 def random_schedule(rng, n_nodes: int, horizon: float = 20.0,
-                    n_ops: int = 6) -> List[Dict]:
+                    n_ops: int = 6, epochs: bool = False) -> List[Dict]:
     """A seeded random schedule for the fuzzer (tools/simnet_fuzz.py):
     draws from the full op catalog, keeps kills bounded so quorum can
     survive, and always heals before the horizon so liveness is
-    checkable afterwards."""
+    checkable afterwards. `epochs=True` adds the epoch-rotation op to
+    the pool (only meaningful when the fuzzer built its Simnet with a
+    validator tail — rotation then interleaves with partitions, kills
+    and floods exactly like production re-election under faults)."""
     ops: List[Dict] = []
     killed: set = set()
     max_kill = max(0, (n_nodes - 1) // 3)
+    pool = ["partition", "link", "kill_restart", "failpoint",
+            "equivocate", "garbage", "tx"]
+    if epochs:
+        pool += ["epoch", "epoch"]  # rotation-heavy: churn is the point
     for _ in range(n_ops):
         at = round(rng.uniform(1.0, horizon * 0.6), 3)
-        kind = rng.choice(
-            ["partition", "link", "kill_restart", "failpoint",
-             "equivocate", "garbage", "tx"]
-        )
+        kind = rng.choice(pool)
         if kind == "partition":
             cut = rng.randrange(1, n_nodes)
             idxs = list(range(n_nodes))
@@ -214,6 +237,10 @@ def random_schedule(rng, n_nodes: int, horizon: float = 20.0,
             ops.append({"at": at, "op": "garbage",
                         "node": rng.randrange(n_nodes),
                         "votes": rng.randrange(1, 4)})
+        elif kind == "epoch":
+            ops.append({"at": at, "op": "epoch",
+                        "node": rng.randrange(n_nodes),
+                        "churn": round(rng.uniform(0.1, 0.5), 2)})
         else:
             ops.append({"at": at, "op": "tx",
                         "node": rng.randrange(n_nodes),

@@ -368,8 +368,55 @@ class BlockExecutor:
                 Validator(PubKey(u.pub_key, u.key_type), u.power)
                 for u in resp.validator_updates
             ]
-            next_vals.update_with_change_set(changes)
+            # Robustness deviations from the reference (which panics
+            # here, halting the chain) — both filters are
+            # DETERMINISTIC (every honest node sees the same
+            # next_vals and the same updates, so every node drops the
+            # same entries), logged, and consensus-safe:
+            #  * duplicate addresses collapse to the LAST update (two
+            #    rotations of one validator in one block);
+            #  * a removal of a validator not in the set — e.g. a
+            #    rotation tx whose matching ADD was dropped under
+            #    overload — is filtered out instead of wedging
+            #    consensus on an unapplicable change set;
+            #  * a negative-power update (a buggy app) is likewise
+            #    dropped, not allowed to raise out of apply_block.
+            by_addr = {c.address: c for c in changes}
+            if len(by_addr) != len(changes):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "collapsing %d duplicate validator update(s) at "
+                    "height %d (last per address wins)",
+                    len(changes) - len(by_addr), block.header.height)
+                changes = list(by_addr.values())
+            dropped = [c for c in changes
+                       if c.voting_power < 0
+                       or (c.voting_power == 0
+                           and not next_vals.has_address(c.address))]
+            if dropped:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "dropping %d unapplicable validator update(s) at "
+                    "height %d (removal not in the set, or negative "
+                    "power — the app emitted an update the set "
+                    "cannot take)", len(dropped), block.header.height)
+                dropped_addrs = {c.address for c in dropped}
+                changes = [c for c in changes
+                           if c.address not in dropped_addrs]
+            if changes:
+                next_vals.update_with_change_set(changes)
             lhvc = block.header.height + 1 + 1
+            # epoch rotation: hand the e+1 set to the async table
+            # warmer (verifyplane/warmer.py) so its device window
+            # tables build in the background while epoch e is still
+            # live — the first post-rotation commit then verifies
+            # against a warm cache instead of paying the build inline.
+            # Cheap no-op when no warmer is registered (simnet, tests).
+            from cometbft_tpu.verifyplane import warmer as vp_warmer
+
+            vp_warmer.notify_next_valset(next_vals)
         next_vals.increment_proposer_priority(1)
         return replace(
             state,

@@ -29,6 +29,13 @@ from cometbft_tpu.verifyplane.plane import (
     plane_batch_fn,
     set_global_plane,
 )
+from cometbft_tpu.verifyplane.warmer import (
+    TableWarmer,
+    clear_global_warmer,
+    global_warmer,
+    notify_next_valset,
+    set_global_warmer,
+)
 
 __all__ = [
     "LANE_BULK",
@@ -42,9 +49,14 @@ __all__ = [
     "PlaneQueueFull",
     "PlaneStopped",
     "QuorumGroup",
+    "TableWarmer",
     "VerifyFuture",
     "VerifyPlane",
     "clear_global_plane",
+    "clear_global_warmer",
+    "global_warmer",
+    "notify_next_valset",
+    "set_global_warmer",
     "dump_flushes",
     "global_plane",
     "ledger_advanced",

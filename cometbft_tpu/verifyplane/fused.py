@@ -69,7 +69,7 @@ class _Plan:
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
                  "counted_pos", "n_commits", "pubs_v", "powers_v",
                  "pending", "mesh", "n_dev", "thresh", "devs",
-                 "drain_first")
+                 "drain_first", "warm")
 
 
 def _eligible(batch):
@@ -373,6 +373,10 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
     plan.devs = (None if mesh is None
                  else tuple(int(d.id) for d in mesh.devices.flat))
     plan.drain_first = took_full
+    # did the dispatch find its valset table cached? (set by
+    # dispatch_fused; the plane stamps it into the ledger's warm
+    # column so post-rotation cold builds are attributable)
+    plan.warm = False
     return plan
 
 
@@ -418,7 +422,8 @@ def dispatch_fused(plan: _Plan) -> None:
         # pubs_v/powers_v are the QuorumGroup's immutable tuples, so the
         # content-key digest is identity-memoized (no per-flush O(valset)
         # hashing) and a steady-state flush never re-uploads the valset
-        table = ec.table_for_pubs(plan.pubs_v, plan.powers_v)
+        table, plan.warm = ec.table_for_pubs_info(plan.pubs_v,
+                                                  plan.powers_v)
         plan.pending = ec.verify_tally_rows_cached(
             plan.rows, table, plan.n_commits
         )
@@ -428,8 +433,8 @@ def dispatch_fused(plan: _Plan) -> None:
 
     from cometbft_tpu.parallel import mesh as pm
 
-    table = ec.sharded_table_for_pubs(plan.pubs_v, plan.powers_v,
-                                      plan.mesh)
+    table, plan.warm = ec.sharded_table_for_pubs_info(
+        plan.pubs_v, plan.powers_v, plan.mesh)
     step = pm.sharded_fused_verify(plan.mesh, plan.n_commits)
     axis = plan.mesh.axis_names[0]
     rows_d = jax.device_put(

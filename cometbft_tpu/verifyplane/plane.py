@@ -156,10 +156,10 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
  _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_BRK, _L_SMISS,
  _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
- _L_NHOST, _L_DEV0) = range(21)
+ _L_NHOST, _L_DEV0, _L_WARM) = range(22)
 # internal slots past the FIELDS window: two ns stamps + the clock
 # generation they were taken under (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN = 21, 22, 23
+_L_T0NS, _L_TPACKED, _L_GEN = 22, 23, 24
 
 
 class FlushLedger:
@@ -175,11 +175,15 @@ class FlushLedger:
     staging-pool misses charged to this flush, the queue depth left
     behind, the per-lane row split (c_rows CONSENSUS / g_rows GATEWAY /
     b_rows BULK), how many sheddable-lane submissions were shed at
-    this drain, and the flush's sub-mesh attribution: n_dev (1 =
+    this drain, the flush's sub-mesh attribution: n_dev (1 =
     single-device/host pass, >1 = the cross-chip sharded mesh pass),
     n_host (always 1 today — pre-plumbed for the multi-host DCN round)
     and dev0 (first device id of the flush's sub-mesh, so two deck
-    flights on disjoint halves are visibly disjoint in /dump_flushes).
+    flights on disjoint halves are visibly disjoint in /dump_flushes)
+    — and ``warm``: 1 when a fused flush found its valset window table
+    already cached (LRU hit), 0 when it paid the build/patch inline
+    (the cold first-commit-after-rotation stall the next-epoch table
+    warmer exists to kill; non-table paths record 0).
     Written by the dispatcher even when tracing is off; read by
     /dump_flushes, the scrape-time /metrics percentiles, and simnet
     replay blobs."""
@@ -188,7 +192,7 @@ class FlushLedger:
               "flight_ms", "collect_ms", "settle_ms", "airborne",
               "path", "breaker", "staging_miss", "depth",
               "c_rows", "g_rows", "b_rows", "shed", "n_dev",
-              "n_host", "dev0")
+              "n_host", "dev0", "warm")
 
     __slots__ = ("_ring",)
 
@@ -229,6 +233,9 @@ class FlushLedger:
                 f"settle={r[_L_SETTLE]}ms"
                 + (f" x{r[_L_NDEV]}dev" if r[_L_NDEV] > 1 else "")
                 + (f" air={r[_L_AIR]}" if r[_L_AIR] else "")
+                + (" cold" if r[_L_PATH] in (PATH_FUSED,
+                                             PATH_FUSED_SHARDED)
+                   and not r[_L_WARM] else "")
             )
         return out
 
@@ -287,6 +294,18 @@ class FlushLedger:
                 "airborne_max": int(max(cols["airborne"], default=0)),
                 "overlapped_flushes": sum(
                     1 for a in cols["airborne"] if a),
+            },
+            # valset-table attribution over the fused paths: cold = a
+            # flush that paid the table build/patch inline (the
+            # post-rotation stall /dump_flushes localizes; the warmer
+            # exists to keep this 0 across epochs)
+            "tables": {
+                "warm": sum(1 for p, w in zip(cols["path"], cols["warm"])
+                            if w and p in (PATH_FUSED,
+                                           PATH_FUSED_SHARDED)),
+                "cold": sum(1 for p, w in zip(cols["path"], cols["warm"])
+                            if not w and p in (PATH_FUSED,
+                                               PATH_FUSED_SHARDED)),
             },
         }
 DEFAULT_RESULT_TIMEOUT = 30.0
@@ -685,7 +704,7 @@ class VerifyPlane:
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
                 0, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
-                1, 0,
+                1, 0, 0,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -960,7 +979,7 @@ class VerifyPlane:
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, 0, PATH_SHED_ONLY,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed), 0, 0, 0,
+                        len(shed), 0, 0, 0, 0,
                     ])
             if not batch:
                 # nothing to pack: land a flight (the first READY one,
@@ -1176,7 +1195,7 @@ class VerifyPlane:
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, 0,
                PATH_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
-               0, t0, t0, gen]
+               0, 0, t0, t0, gen]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path
@@ -1209,7 +1228,6 @@ class VerifyPlane:
                 self._mesh = fz.plane_mesh(self._mesh_devices)
             except Exception:  # noqa: BLE001 - no backend: stay single
                 self._mesh = None
-            self._mesh_resolved = True
             self.mesh_ndev = (0 if self._mesh is None
                               else int(self._mesh.devices.size))
             if self.flights > 1 and self._mesh is not None:
@@ -1217,6 +1235,12 @@ class VerifyPlane:
                 # sub-mesh seam effective_mesh clamps through; meshes
                 # under 4 devices have none (single-flight dispatch)
                 self._halves = fz.half_meshes(self._mesh)
+            # published LAST: the warmer's _mesh_targets reads
+            # (_mesh_resolved, _mesh, _halves) from its own thread —
+            # seeing resolved=True with the halves still unassigned
+            # would warm the full mesh instead of the halves flushes
+            # actually look tables up under
+            self._mesh_resolved = True
             if self.metrics is not None:
                 self.metrics.plane_shard_ndev.set(float(self.mesh_ndev))
         return self._mesh
@@ -1285,6 +1309,13 @@ class VerifyPlane:
                     led[_L_DEV0] = plan.devs[0]
                 else:
                     led[_L_PATH] = PATH_FUSED
+                # warm: did this flush find its valset table cached,
+                # or pay the build inline (the post-rotation stall)?
+                led[_L_WARM] = 1 if plan.warm else 0
+                if not plan.warm and tracing.enabled():
+                    tracing.instant("plane.cold_table",
+                                    cat="verifyplane", flush=fid,
+                                    rows=len(rows))
                 led[_L_SMISS] = self._staging.misses - miss0
 
                 def finish():

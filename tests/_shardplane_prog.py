@@ -183,7 +183,15 @@ recs = plane_m.dump_flushes()["flushes"]
 shard_recs = [r for r in recs if r["path"] == "fused_sharded"]
 assert shard_recs and all(r["n_dev"] == EXPECT_NDEV
                           for r in shard_recs), recs
+# the ledger's warm column (ISSUE 12): the FIRST sharded flush paid
+# the table build inline (cold, warm=0); the steady-state flush found
+# it cached (warm=1) — exactly how /dump_flushes attributes a
+# post-rotation stall
+assert shard_recs[0]["warm"] == 0, shard_recs
+assert shard_recs[-1]["warm"] == 1, shard_recs
 summary = plane_m.dump_flushes()["summary"]
+assert summary["tables"]["cold"] >= 1
+assert summary["tables"]["warm"] >= 1
 assert summary["shard"]["flushes"] >= 2
 assert summary["shard"]["n_dev_max"] == EXPECT_NDEV
 stats = plane_m.stats()

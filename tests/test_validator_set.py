@@ -130,3 +130,116 @@ def test_state_store_roundtrip_preserves_proposer(tmp_path):
     hist = store.load_validators(state.last_block_height + 1)
     assert hist.get_proposer().address == want
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# Epoch-rotation edges (ISSUE 12): the churn path's interaction with
+# the proposer memo and the valset-table identity memo.
+# ---------------------------------------------------------------------------
+
+
+def test_proposer_persists_across_rotation_and_restart(tmp_path):
+    """The PR 3 proposer-persistence fix, extended through a ROTATION:
+    a committee re-election (update_with_change_set) immediately before
+    a restart must reload the same selected proposer — rotation clears
+    the proposer memo, selection re-runs, and the persisted row must
+    carry the NEW selection, not a re-derivation."""
+    from dataclasses import replace
+
+    from cometbft_tpu.state.state import State, StateStore
+
+    vals = mkvals([10, 10, 10, 10])
+    vs = ValidatorSet(vals)
+    # the rotation: one member out, one in, one repowered
+    newv = mkvals([1, 1, 1, 1, 25])[4]
+    vs.update_with_change_set([
+        Validator(vals[2].pub_key, 0),
+        Validator(vals[0].pub_key, 14),
+        newv,
+    ])
+    vs.increment_proposer_priority(1)  # select post-rotation proposer
+    want = vs.get_proposer().address
+    assert vs.has_address(want)  # the selection is a current member
+
+    state = State.make_genesis("rot-chain", ValidatorSet(mkvals([10] * 4)))
+    state = replace(state, validators=vs, next_validators=vs.copy())
+    store = StateStore(str(tmp_path / "state.db"))
+    store.save(state)
+    loaded = store.load()
+    assert loaded.validators.get_proposer().address == want
+    assert sorted(v.address for v in loaded.validators.validators) == \
+        sorted(v.address for v in vs.validators)
+    store.close()
+
+
+def test_rotation_invalidates_table_identity_memo(monkeypatch):
+    """table_for_valset memoizes by (set identity, validators-list
+    identity). BOTH rotation shapes must invalidate it: a
+    membership change AND a power-only change (each replaces the
+    validators list wholesale in update_with_change_set) — a stale
+    table would verify against retired keys or tally stale powers."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    tables = []
+
+    def fake_table_for_pubs(pubs, powers=None):
+        tables.append((pubs, powers))
+        return object()
+
+    monkeypatch.setattr(ec, "table_for_pubs", fake_table_for_pubs)
+    ec._VALSET_MEMO.clear()
+
+    vals = mkvals([10, 20, 30])
+    vs = ValidatorSet(vals)
+    t1 = ec.table_for_valset(vs)
+    assert ec.table_for_valset(vs) is t1  # steady state: memo hit
+
+    # power-only change: same membership, new power
+    vs.update_with_change_set([Validator(vals[0].pub_key, 11)])
+    t2 = ec.table_for_valset(vs)
+    assert t2 is not t1
+    assert tables[-1][1] != tables[0][1]  # the new powers reached it
+
+    # membership change: one out, one in
+    newv = mkvals([1, 1, 1, 40])[3]
+    vs.update_with_change_set([Validator(vals[1].pub_key, 0), newv])
+    t3 = ec.table_for_valset(vs)
+    assert t3 is not t2
+    assert newv.pub_key.data in tables[-1][0]
+
+
+def test_rotated_out_valset_memo_entry_evictable(monkeypatch):
+    """A retired epoch's table must be GC-able once the bounded caches
+    evict it: neither the valset memo nor any QuorumGroup-tuple memo
+    may keep a strong ref past eviction."""
+    import gc
+    import weakref
+
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import table_cache as tc
+
+    class _T:  # weakref-able stand-in (object() is not)
+        pass
+
+    monkeypatch.setattr(ec, "table_for_pubs",
+                        lambda pubs, powers=None: _T())
+    ec._VALSET_MEMO.clear()
+    saved = tc.capacities()
+    tc.set_capacities(valset_memo=2)
+    try:
+        vs = ValidatorSet(mkvals([10, 20]))
+        old = ec.table_for_valset(vs)
+        ref = weakref.ref(old)
+        del old
+        # two epochs of churn push the retired entry out of the memo
+        for power in (11, 12):
+            vs2 = ValidatorSet(mkvals([10, 20]))
+            vs2.update_with_change_set(
+                [Validator(vs2.validators[0].pub_key, power)])
+            ec.table_for_valset(vs2)
+        ec.table_for_valset(ValidatorSet(mkvals([5, 5, 5])))
+        gc.collect()
+        assert ref() is None, \
+            "rotated-out epoch's table still strongly referenced"
+    finally:
+        tc.set_capacities(**saved)

@@ -1,0 +1,622 @@
+"""Epoch-churn cache bounding + the next-epoch table warmer (ISSUE 12).
+
+Everything here is host-only and jax-free by design: the bounded-LRU /
+eviction / warm-attribution core lives in cometbft_tpu/ops/table_cache
+and the warmer's machinery takes an injected build_fn, so the churn
+survival properties — memory flat across N epochs, the LIVE epoch's
+table never evicted, warmer faults degrading to the cold path — are
+provable on the 1-core tier-1 host without a device build.
+"""
+import gc
+import threading
+import weakref
+
+import pytest
+
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.ops import table_cache as tc
+from cometbft_tpu.verifyplane import warmer as wm
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fp.reset()
+    yield
+    fp.reset()
+    wm.set_global_warmer(None)
+    wm._LAST = None
+
+
+class FakeTable:
+    """Sized stand-in for a ValsetTable (duck-typed via nbytes)."""
+
+    def __init__(self, nbytes=1000):
+        self.nbytes = nbytes
+
+
+# ---------------------------------------------------------------------------
+# bounded caches: eviction pressure, live-table safety, accounting
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_lru_eviction_pressure_holds_memory_flat():
+    """N epochs of churn through a capacity-C cache: resident bytes
+    stay bounded by C tables, evictions are counted honestly, and the
+    LIVE epoch's table — touched by every flush — never evicts."""
+    cache = tc.BoundedLRU("tables", 4, size_fn=tc.default_size)
+    ev0 = tc.STATS["evictions_tables"]
+    live_key = b"live-epoch"
+    cache.put(live_key, FakeTable(10_000))
+    peak = 0
+    for epoch in range(50):
+        # a steady flush stream hits the live table between epochs
+        assert cache.get(live_key) is not None, f"live evicted @ {epoch}"
+        cache.put(b"epoch-%d" % epoch, FakeTable(10_000))
+        peak = max(peak, cache.resident_bytes())
+        assert len(cache) <= 4
+    assert peak <= 4 * 10_000  # memory flat: never more than capacity
+    assert tc.STATS["evictions_tables"] - ev0 == 50 - 3  # honest count
+    assert cache.get(live_key) is not None  # survived all 50 epochs
+
+
+def test_set_capacity_trims_and_clamps():
+    cache = tc.BoundedLRU("tables", 8, size_fn=tc.default_size)
+    for i in range(8):
+        cache.put(i, FakeTable(100))
+    ev0 = tc.STATS["evictions_tables"]
+    cache.set_capacity(3)
+    assert len(cache) == 3 and cache.resident_bytes() == 300
+    assert tc.STATS["evictions_tables"] - ev0 == 5
+    # capacity 1 would let a next-epoch warm insert evict the LIVE
+    # table mid-flush: clamped to 2
+    cache.set_capacity(1)
+    assert cache.capacity == 2
+
+
+def test_rotated_out_table_is_actually_evictable():
+    """The churn leak regression: once the bounded caches drop a
+    retired epoch's entries, NOTHING keeps the old table alive — no
+    lingering strong ref via memo tuples (weakref dies after gc)."""
+    cache = tc.BoundedLRU("tables", 2, size_fn=tc.default_size)
+    old = FakeTable(5000)
+    ref = weakref.ref(old)
+    cache.put(b"epoch-0", old)
+    del old
+    cache.put(b"epoch-1", FakeTable(5000))
+    cache.put(b"epoch-2", FakeTable(5000))  # evicts epoch-0
+    gc.collect()
+    assert ref() is None, "rotated-out table still strongly referenced"
+
+
+def test_config_capacities_flow_into_caches():
+    from cometbft_tpu.config.config import Config, ConfigError
+
+    saved = tc.capacities()
+    try:
+        cfg = Config()
+        cfg.crypto.table_cache_tables = 5
+        cfg.crypto.table_cache_shard_tables = 3
+        cfg.crypto.table_cache_memo_entries = 4
+        cfg.validate_basic()
+        cfg.crypto.apply_table_cache()
+        caps = tc.capacities()
+        assert caps["tables"] == 5 and caps["shard_tables"] == 3
+        assert caps["valset_memo"] == 4 and caps["key_memo"] == 8
+        cfg.crypto.table_cache_tables = 1
+        with pytest.raises(ConfigError):
+            cfg.validate_basic()
+        # the deck keeps a live sharded table per half: flights > 1
+        # needs shard-cache headroom for a both-halves warm
+        cfg.crypto.table_cache_tables = 8
+        cfg.crypto.table_cache_shard_tables = 2
+        cfg.verify_plane.pipeline_flights = 2
+        with pytest.raises(ConfigError):
+            cfg.validate_basic()
+        cfg.crypto.table_cache_shard_tables = 4
+        cfg.validate_basic()
+    finally:
+        tc.set_capacities(**saved)
+
+
+def test_warm_next_epoch_knob_builds_warmer():
+    """[verify_plane] warm_next_epoch gates the node's TableWarmer;
+    the knob survives a TOML round trip."""
+    from cometbft_tpu.config.config import (
+        Config,
+        load_config,
+        save_config,
+    )
+
+    cfg = Config()
+    cfg.verify_plane.enable = True
+    assert cfg.verify_plane.warm_next_epoch is True  # default on
+    assert isinstance(cfg.verify_plane.build_warmer(), wm.TableWarmer)
+    cfg.verify_plane.warm_next_epoch = False
+    assert cfg.verify_plane.build_warmer() is None
+    cfg.verify_plane.enable = False
+    cfg.verify_plane.warm_next_epoch = True
+    assert cfg.verify_plane.build_warmer() is None  # plane off: no warm
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "config.toml")
+        cfg.verify_plane.warm_next_epoch = False
+        save_config(cfg, p)
+        assert load_config(p).verify_plane.warm_next_epoch is False
+
+
+def test_warmed_key_attribution_bounded():
+    base = tc.STATS["warmed_hits"]
+    tc.note_warmed(b"k1")
+    assert tc.consume_warmed(b"k1") is True
+    assert tc.consume_warmed(b"k1") is False  # one hit per warm
+    assert tc.STATS["warmed_hits"] - base == 1
+    for i in range(100):
+        tc.note_warmed(b"flood-%d" % i)
+    assert len(tc._WARMED) <= tc._WARMED_MAX
+
+
+# ---------------------------------------------------------------------------
+# the warmer: build, degrade, supersede, stop-mid-warm
+# ---------------------------------------------------------------------------
+
+
+class FakeBreaker:
+    def __init__(self, state="closed"):
+        self.state = state
+
+
+def test_warmer_builds_and_attributes(tmp_path):
+    built = []
+    w = wm.TableWarmer(build_fn=lambda p, pw: built.append((p, pw)),
+                       breaker=FakeBreaker())
+    w.start()
+    try:
+        w.request((b"a" * 32, b"b" * 32), (5, 7))
+        assert w.wait_idle(5.0)
+        assert built == [((b"a" * 32, b"b" * 32), (5, 7))]
+        assert w.stats()["builds_ok"] == 1
+    finally:
+        w.stop()
+
+
+def test_warmer_failpoint_degrades_to_cold_path():
+    """warmer.build raising must count a failure and touch nothing —
+    the next rotation simply takes the cold path."""
+    built = []
+    fp.registry().arm_from_spec("warmer.build=raise*1")
+    w = wm.TableWarmer(build_fn=lambda p, pw: built.append(1),
+                       breaker=FakeBreaker())
+    w.start()
+    try:
+        w.request((b"x",), (1,))
+        assert w.wait_idle(5.0)
+        assert built == [] and w.stats()["builds_failed"] == 1
+        # the armed shot is spent: the next warm succeeds
+        w.request((b"y",), (1,))
+        assert w.wait_idle(5.0)
+        assert built == [1] and w.stats()["builds_ok"] == 1
+    finally:
+        w.stop()
+
+
+def test_warmer_skips_when_breaker_open():
+    built = []
+    brk = FakeBreaker("open")
+    w = wm.TableWarmer(build_fn=lambda p, pw: built.append(1),
+                       breaker=brk)
+    w.start()
+    try:
+        w.request((b"x",), (1,))
+        assert w.wait_idle(5.0)
+        assert built == [] and w.stats()["builds_skipped"] == 1
+        brk.state = "closed"
+        w.request((b"x",), (1,))
+        assert w.wait_idle(5.0)
+        assert built == [1]
+    finally:
+        w.stop()
+
+
+def test_warmer_no_device_no_buildfn_skips():
+    w = wm.TableWarmer(breaker=FakeBreaker(), use_device=False)
+    w.start()
+    try:
+        w.request((b"x",), (1,))
+        assert w.wait_idle(5.0)
+        assert w.stats()["builds_skipped"] == 1
+    finally:
+        w.stop()
+
+
+def test_warmer_latest_request_wins():
+    """Back-to-back rotations: an unstarted older request is
+    superseded — the warmer never builds a stale epoch's table."""
+    gate = threading.Event()
+    built = []
+
+    def slow_build(p, pw):
+        built.append(p)
+        gate.wait(5.0)
+
+    w = wm.TableWarmer(build_fn=slow_build, breaker=FakeBreaker())
+    w.start()
+    try:
+        w.request((b"e1",), None)
+        # wait until e1's build is holding the gate, then pile on
+        for _ in range(200):
+            if built:
+                break
+            threading.Event().wait(0.01)
+        assert built == [(b"e1",)]
+        w.request((b"e2",), None)
+        w.request((b"e3",), None)  # supersedes e2 before it starts
+        gate.set()
+        assert w.wait_idle(5.0)
+        assert built == [(b"e1",), (b"e3",)]
+        assert w.stats()["superseded"] == 1
+    finally:
+        w.stop()
+
+
+def test_warmer_stop_mid_warm_is_clean():
+    """stop() during a wedged build returns promptly (the build is
+    abandoned to its daemon thread) and later requests are refused."""
+    gate = threading.Event()
+    w = wm.TableWarmer(build_fn=lambda p, pw: gate.wait(10.0),
+                       breaker=FakeBreaker())
+    w.start()
+    w.request((b"e1",), None)
+    import time
+
+    t0 = time.monotonic()
+    w.stop()
+    assert time.monotonic() - t0 < 5.0
+    assert not w.is_running()
+    w.request((b"e2",), None)  # no-op on a stopped warmer
+    gate.set()
+
+
+def test_notify_next_valset_plumbs_through_global():
+    """state/execution.py's seam: a registered running warmer receives
+    the extracted (pubs, powers) columns; with none registered the
+    notify is a no-op."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    privs = [PrivKey.generate(bytes([40 + i]) * 32) for i in range(3)]
+    vs = ValidatorSet([Validator(p.pub_key(), 10 + i)
+                       for i, p in enumerate(privs)])
+    wm.notify_next_valset(vs)  # no warmer: must not raise
+
+    built = []
+    w = wm.TableWarmer(build_fn=lambda p, pw: built.append((p, pw)),
+                       breaker=FakeBreaker())
+    w.start()
+    wm.set_global_warmer(w)
+    try:
+        wm.notify_next_valset(vs)
+        assert w.wait_idle(5.0)
+        assert len(built) == 1
+        pubs, powers = built[0]
+        assert pubs == tuple(v.pub_key.data for v in vs.validators)
+        assert powers == tuple(v.voting_power for v in vs.validators)
+    finally:
+        wm.clear_global_warmer(w)
+        w.stop()
+
+
+def test_warmer_metrics_scrape():
+    """/metrics: the warmer build outcomes, eviction counters, warm
+    hits and resident bytes all surface (lint-clean names) at scrape
+    time, sampled from the jax-free core."""
+    from cometbft_tpu.libs.metrics import NodeMetrics
+    from tools.metrics_lint import lint_registry
+
+    w = wm.TableWarmer(build_fn=lambda p, pw: None,
+                       breaker=FakeBreaker())
+    w.start()
+    wm.set_global_warmer(w)
+    try:
+        w.request((b"m1",), None)
+        assert w.wait_idle(5.0)
+        tc.note_warmed(b"scrape-test")
+        tc.consume_warmed(b"scrape-test")
+        m = NodeMetrics()
+        assert lint_registry(m.registry) == []
+        text = m.expose_text()
+        assert "cometbft_crypto_table_cache_evictions_total" in text
+        assert "cometbft_crypto_table_cache_resident_bytes" in text
+        assert ('cometbft_verifyplane_valset_warmer_builds_total'
+                '{outcome="ok"} 1') in text
+        assert "cometbft_verifyplane_valset_warmer_hits_total" in text
+    finally:
+        wm.clear_global_warmer(w)
+        w.stop()
+
+
+def test_rotation_on_live_node_reaches_warmer(tmp_path):
+    """End to end through the REAL path: a kvstore ``val:`` tx commits
+    on a live single-node chain -> finalize_block validator_updates ->
+    update_with_change_set -> state/execution.py notifies the warmer
+    with the epoch e+1 columns (the new member present, at its new
+    power)."""
+    import base64
+    import time
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    priv = PrivKey.generate(b"\x61" * 32)
+    vals = ValidatorSet([Validator(priv.pub_key(), 10)])
+    state = State.make_genesis("warm-e2e", vals)
+    built = []
+    w = wm.TableWarmer(build_fn=lambda p, pw: built.append((p, pw)),
+                       breaker=FakeBreaker())
+    w.start()
+    wm.set_global_warmer(w)
+    node = Node(KVStoreApplication(), state,
+                privval=FilePV(priv), home=str(tmp_path / "n0"))
+    try:
+        node.start()
+        new_pub = PrivKey.generate(b"\x62" * 32).pub_key().data
+        tx = b"val:" + base64.b64encode(new_pub) + b"!7!e1"
+        node.mempool.check_tx(tx)
+        deadline = time.monotonic() + 30
+        while not built and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert built, "rotation never reached the warmer"
+        pubs, powers = built[0]
+        assert new_pub in pubs
+        assert powers[pubs.index(new_pub)] == 7
+    finally:
+        node.stop()
+        wm.clear_global_warmer(w)
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# rotation hardening (review findings): duplicate updates in one
+# block, warm-attribution honesty, and mesh-key targeting
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_dedups_validator_updates_last_wins():
+    """Two rotations of ONE validator landing in the same block (out
+    at epoch k, back in at k+1) must collapse to a single update —
+    update_with_change_set rejects duplicate addresses, and that
+    rejection would halt the chain on every honest node."""
+    import base64
+
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    b64 = base64.b64encode(b"\x10" * 32)
+    resp = app.finalize_block(abci.RequestFinalizeBlock(
+        txs=[b"val:" + b64 + b"!0!e1", b"val:" + b64 + b"!5!e2"],
+        height=1))
+    assert [r.code for r in resp.tx_results] == [0, 0]
+    assert len(resp.validator_updates) == 1
+    assert resp.validator_updates[0].power == 5  # last tx wins
+
+
+def test_kvstore_rejects_negative_power():
+    """A negative-power val tx is malformed at every gate (CheckTx,
+    ProcessProposal, FinalizeBlock result) — update_with_change_set
+    raises on negative power, so letting it through would hand anyone
+    a one-tx chain halt."""
+    import base64
+
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    tx = b"val:" + base64.b64encode(b"\x11" * 32) + b"!-1"
+    assert app.check_tx(abci.RequestCheckTx(tx=tx)).code == 1
+    assert app.process_proposal(
+        abci.RequestProcessProposal(txs=[tx])
+    ).status == abci.PROCESS_PROPOSAL_REJECT
+    resp = app.finalize_block(abci.RequestFinalizeBlock(txs=[tx],
+                                                        height=1))
+    assert resp.tx_results[0].code == 1
+    assert resp.validator_updates == []
+
+
+def test_warmer_repeat_notify_does_not_self_consume(monkeypatch):
+    """A repeat warm request for an IDENTICAL valset must not let the
+    warmer's own lookup pop the still-pending warm mark (that would
+    count a warmed_hit no verifier ever saw): the warmer peeks the
+    cache instead of running the consuming hit path."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    pubs, powers = (b"repeat-epoch" * 2 + b"xxxxxxxx",), (3,)
+    key = ec._cache_key(pubs, powers)
+    calls = []
+    monkeypatch.setattr(
+        ec, "table_for_pubs_info",
+        lambda p, pw: (calls.append(1) or (FakeTable(), False)))
+    w = wm.TableWarmer(breaker=FakeBreaker(), use_device=True,
+                       mesh_fn=lambda: None)
+    w.start()
+    try:
+        hits0 = tc.STATS["warmed_hits"]
+        w.request(pubs, powers)
+        assert w.wait_idle(5.0)
+        assert len(calls) == 1 and key in tc._WARMED
+        # the table is now cached; a repeat notify peeks, skips the
+        # consuming lookup, and leaves the mark pending
+        with tc.LOCK:
+            tc.TABLES.put(key, FakeTable())
+        w.request(pubs, powers)
+        assert w.wait_idle(5.0)
+        assert len(calls) == 1  # no second lookup at all
+        assert key in tc._WARMED  # mark still pending for a verifier
+        assert tc.STATS["warmed_hits"] == hits0
+    finally:
+        w.stop()
+        with tc.LOCK:
+            tc.TABLES.pop(key)
+        tc._WARMED.pop(key, None)
+
+
+def test_flush_mesh_publishes_halves_before_resolved():
+    """The warmer reads (_mesh_resolved, _mesh, _halves) from its own
+    thread: the plane must assign the halves BEFORE publishing
+    _mesh_resolved, or a concurrent warm targets the full mesh whose
+    key no deck flush ever looks up."""
+    import inspect
+
+    from cometbft_tpu.verifyplane.plane import VerifyPlane
+
+    src = inspect.getsource(VerifyPlane._flush_mesh)
+    assert src.index("self._halves") < src.index(
+        "self._mesh_resolved = True"), \
+        "_mesh_resolved published before _halves is assigned"
+
+
+def test_update_state_filters_unapplicable_changes():
+    """The engine-side belt-and-braces: duplicate addresses collapse
+    (last wins) and removals of not-in-set validators drop — both
+    deterministically — instead of wedging apply_block."""
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.state import State
+    from cometbft_tpu.types.block import Block, Data, Header
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    privs = [PrivKey.generate(bytes([50 + i]) * 32) for i in range(3)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    state = State.make_genesis("filter-chain", vals)
+    ex = BlockExecutor(None, None)
+    header = Header(chain_id="filter-chain", height=1,
+                    time=Timestamp(1_700_000_000, 0))
+    block = Block(header, Data([]), None)
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+    ghost = PrivKey.generate(b"\x77" * 32).pub_key()
+    neg = PrivKey.generate(b"\x78" * 32).pub_key()
+    dup = privs[0].pub_key()
+    resp = abci.ResponseFinalizeBlock(
+        tx_results=[], app_hash=b"",
+        validator_updates=[
+            abci.ValidatorUpdate(dup.data, 0),      # out...
+            abci.ValidatorUpdate(dup.data, 17),     # ...and back: wins
+            abci.ValidatorUpdate(ghost.data, 0),    # never a member
+            abci.ValidatorUpdate(neg.data, -5),     # buggy app
+        ])
+    new_state = ex._update_state(state, bid, block, resp)
+    nv = new_state.next_validators
+    assert nv.has_address(dup.address())
+    _, v = nv.get_by_address(dup.address())
+    assert v.voting_power == 17
+    assert not nv.has_address(ghost.address())
+    assert not nv.has_address(neg.address())
+    assert len(nv) == 3
+
+
+def test_warmer_does_not_claim_tables_built_cold(monkeypatch):
+    """Honest attribution: when the rotation's first commit beat the
+    warm (consensus paid the cold build, the warmer's lookup is a
+    HIT), the warmer must NOT mark the key — warmed_hits would credit
+    the warmer for a stall that actually happened."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    sent = {"hit": True}
+    monkeypatch.setattr(ec, "table_for_pubs_info",
+                        lambda p, pw: (object(), sent["hit"]))
+    noted = []
+    monkeypatch.setattr(ec, "note_warmed", noted.append)
+    w = wm.TableWarmer(breaker=FakeBreaker(), use_device=True,
+                       mesh_fn=lambda: None)
+    w.start()
+    try:
+        w.request((b"cold-already-paid",), (1,))
+        assert w.wait_idle(5.0)
+        assert noted == []  # hit: no false credit
+        sent["hit"] = False
+        w.request((b"genuinely-warmed",), (1,))
+        assert w.wait_idle(5.0)
+        assert len(noted) == 1  # built: attributed
+    finally:
+        w.stop()
+
+
+def test_warmer_mesh_targets_match_dispatch_keys(monkeypatch):
+    """The warm must target the meshes flushes actually look tables up
+    under: the effective_mesh-clamped fan-out, and the deck's HALVES
+    when pipeline_flights configured them — warming the full resolved
+    mesh would never match a clamped/half lookup key."""
+    from types import SimpleNamespace
+
+    from cometbft_tpu.verifyplane import fused as fz
+    from cometbft_tpu.verifyplane import plane as vp
+
+    mesh8 = fz.plane_mesh(0)
+    assert mesh8 is not None and mesh8.devices.size == 8
+    halves = fz.half_meshes(mesh8)
+    assert len(halves) == 2
+
+    w = wm.TableWarmer(breaker=FakeBreaker())
+    # no halves (single-flight plane): the effective FULL mesh —
+    # clamped to the devices a 300-validator set actually fills
+    fake = SimpleNamespace(_mesh_resolved=True, _mesh=mesh8,
+                           _halves=[])
+    monkeypatch.setattr(vp, "_GLOBAL", fake)
+    targets = w._mesh_targets(300)
+    assert targets == [fz.effective_mesh(mesh8, 300)[0]]
+    assert targets[0].devices.size < 8  # clamped, not the full mesh
+    # halves configured: BOTH halves' effective meshes (steady deck
+    # flushes ride halves, so those are the lookup keys)
+    fake._halves = halves
+    targets = w._mesh_targets(300)
+    assert targets == [fz.effective_mesh(h, 300)[0] for h in halves]
+    # a valset that fits one stride: no sharded warm at all
+    assert w._mesh_targets(50) == []
+
+
+# ---------------------------------------------------------------------------
+# the election rule (simnet/actors.py)
+# ---------------------------------------------------------------------------
+
+
+def test_proportional_election_deterministic_bounded_churn():
+    from cometbft_tpu.simnet import actors
+
+    stakes = {i: (b"pub-%d" % i, 1 + i % 7) for i in range(40)}
+    committee = list(range(20))
+    standby = list(range(20, 40))
+    c1 = actors.proportional_election(7, 3, committee, standby,
+                                      stakes, 0.25)
+    c2 = actors.proportional_election(7, 3, committee, standby,
+                                      stakes, 0.25)
+    assert c1 == c2  # pure function of (seed, epoch, committee)
+    new_committee, new_standby, out, inn = c1
+    assert len(out) == len(inn) == 5  # exactly 25% of 20
+    assert set(out) <= set(committee) and set(inn) <= set(standby)
+    assert len(new_committee) == 20
+    assert sorted(new_committee + new_standby) == list(range(40))
+    # a different epoch draws a different rotation
+    c3 = actors.proportional_election(7, 4, committee, standby,
+                                      stakes, 0.25)
+    assert c3 != c1
+    # stake-proportionality, coarsely: across many epochs the heaviest
+    # standby members win seats far more often than the lightest
+    wins = {i: 0 for i in standby}
+    for epoch in range(200):
+        _, _, _, inn = actors.proportional_election(
+            11, epoch, committee, standby, stakes, 0.25)
+        for i in inn:
+            wins[i] += 1
+    heavy = [i for i in standby if stakes[i][1] >= 6]
+    light = [i for i in standby if stakes[i][1] <= 2]
+    heavy_rate = sum(wins[i] for i in heavy) / len(heavy)
+    light_rate = sum(wins[i] for i in light) / len(light)
+    assert heavy_rate > 2 * light_rate, (heavy_rate, light_rate)

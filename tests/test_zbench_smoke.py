@@ -36,14 +36,15 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 6  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 7  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
-                               "cfg12_smoke", "cfg2_smoke",
-                               "cfg4_smoke", "cfg6_smoke"]
+                               "cfg12_smoke", "cfg13_smoke",
+                               "cfg2_smoke", "cfg4_smoke",
+                               "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -63,6 +64,13 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     dk = results["cfg12_smoke"]["extra"]
     assert dk["staging_slots"] == 3
     assert dk["deck_summary"]["airborne_max"] == 0
+    # the cfg13 miniature proved churn eviction pressure + the warmer
+    # degrade/attribution plumbing (bounded caches, jax-free)
+    ch = results["cfg13_smoke"]["extra"]
+    assert ch["evictions"] > 0
+    assert ch["resident_bytes_peak"] <= 4 * 4096
+    assert ch["warmer"]["builds_failed"] == 1
+    assert ch["warmer"]["builds_ok"] == 1
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

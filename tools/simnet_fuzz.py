@@ -2,14 +2,18 @@
 """Randomized long-schedule simnet fuzzing with seed replay.
 
 Generates seeded random fault schedules (partitions, link faults,
-kill/restart, per-node failpoints, byzantine actors, txs), runs each
-through the deterministic simnet, and asserts safety + (when a quorum
-survives) liveness + evidence commitment for equivocation schedules.
-Any failure prints the exact `{"seed": ..., "schedule": [...]}` blob;
-rerun it byte-for-byte with --replay.
+kill/restart, per-node failpoints, byzantine actors, txs — and, with
+--extra > 0, proportional epoch rotations interleaved with the rest),
+runs each through the deterministic simnet, and asserts safety + (when
+a quorum survives) liveness + evidence commitment for equivocation
+schedules. Any failure prints the exact `{"seed": ..., "schedule":
+[...]}` blob; rerun it byte-for-byte with --replay. Blobs carry the
+network shape (nodes, horizon, extra) too, so the election state —
+a pure function of (seed, extra, epoch-op order) — replays exactly.
 
 Usage:
     python tools/simnet_fuzz.py --iters 10 --nodes 4 --seed 0
+    python tools/simnet_fuzz.py --iters 10 --extra 12   # + epoch churn
     python tools/simnet_fuzz.py --replay '<json blob from a failure>'
 
 Tier-1 never runs this (it is the long tail); CI or a soak box does.
@@ -35,11 +39,19 @@ from cometbft_tpu.simnet import (  # noqa: E402
 
 
 def run_one(seed: int, schedule, n_nodes: int, horizon: float,
-            verbose: bool) -> None:
+            verbose: bool, extra: int = 0) -> None:
     with tempfile.TemporaryDirectory(prefix="simnet-fuzz-") as d:
-        with Simnet(n_nodes, seed=seed, basedir=d) as sim:
+        # node power dwarfs the passive tail's stake so epoch churn
+        # can never cost quorum (SimNetwork enforces the ratio)
+        kw = ({"power": 100_000, "extra_validators": extra}
+              if extra else {})
+        with Simnet(n_nodes, seed=seed, basedir=d, **kw) as sim:
             sim.run(schedule, max_time=horizon)
             sim.assert_safety()
+            # every epoch op either elected (txs recorded) or loudly
+            # explained why not — silent no-op rotations hide bugs
+            for rec in sim.epoch_results:
+                assert "error" not in rec, rec
             alive = [n for n in sim.net.nodes if n.alive]
             if 3 * len(alive) > 2 * len(sim.net.nodes):
                 sim.assert_liveness(min_new_heights=2, max_time=30.0)
@@ -80,6 +92,9 @@ def main(argv=None) -> int:
                     help="schedule horizon in simulated seconds")
     ap.add_argument("--ops", type=int, default=6,
                     help="random ops per schedule")
+    ap.add_argument("--extra", type=int, default=0,
+                    help="passive tail validators; > 0 adds the "
+                         "epoch-rotation op to the schedule pool")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON blob from a failure: run exactly that")
     ap.add_argument("-v", "--verbose", action="store_true")
@@ -91,10 +106,13 @@ def main(argv=None) -> int:
         # blobs (seed+schedule only) fall back to the CLI flags
         nodes = int(blob.get("nodes", args.nodes))
         horizon = float(blob.get("horizon", args.horizon))
+        extra = int(blob.get("extra", args.extra))
         print(f"replaying seed={blob['seed']} nodes={nodes} "
-              f"horizon={horizon} ({len(blob['schedule'])} ops)")
+              f"horizon={horizon} extra={extra} "
+              f"({len(blob['schedule'])} ops)")
         try:
-            run_one(blob["seed"], blob["schedule"], nodes, horizon, True)
+            run_one(blob["seed"], blob["schedule"], nodes, horizon,
+                    True, extra=extra)
         except SimnetFailure as e:
             print(f"REPRODUCED:\n{e}")
             return 1
@@ -105,16 +123,18 @@ def main(argv=None) -> int:
     for i in range(args.iters):
         seed = args.seed + i
         schedule = random_schedule(random.Random(seed), args.nodes,
-                                   horizon=args.horizon, n_ops=args.ops)
+                                   horizon=args.horizon, n_ops=args.ops,
+                                   epochs=args.extra > 0)
         t0 = time.time()
         print(f"[{i + 1}/{args.iters}] seed={seed} "
               f"ops={[op['op'] for op in schedule]}")
         replay_blob = json.dumps(
             {"seed": seed, "schedule": schedule, "nodes": args.nodes,
-             "horizon": args.horizon}, sort_keys=True)
+             "horizon": args.horizon, "extra": args.extra},
+            sort_keys=True)
         try:
             run_one(seed, schedule, args.nodes, args.horizon,
-                    args.verbose)
+                    args.verbose, extra=args.extra)
         except SimnetFailure as e:
             failures += 1
             print(f"  FAILURE:\n{e}\n  replay (self-contained): "

@@ -220,6 +220,11 @@ def stage_report(events: List[dict]) -> dict:
             "pack_overlapped_ms": round(overlapped / 1000.0, 3),
             "pack_overlap_frac": round(overlapped / pack_total, 3)
             if pack_total else 0.0,
+            # fused flushes that paid a valset table build/patch inline
+            # (plane.cold_table instants): a steady stream should show
+            # 0 — nonzero localizes a post-rotation stall the next-
+            # epoch warmer should have absorbed
+            "cold_tables": instants.get("plane.cold_table", 0),
             "deck": {
                 "max_airborne": occ["max_airborne"],
                 "airborne_ge1_ms": round(occ["ge1_us"] / 1000.0, 3),
@@ -371,6 +376,11 @@ def format_report(rep: dict) -> str:
                   f"pack {p['pack_total_ms']} ms, "
                   f"{p['pack_overlapped_ms']} ms "
                   f"({p['pack_overlap_frac']:.0%}) hidden behind flights"]
+        if p.get("cold_tables"):
+            lines.append(
+                f"COLD TABLES: {p['cold_tables']} fused flush(es) paid "
+                f"a valset table build inline (post-rotation stall — "
+                f"check the next-epoch warmer)")
         d = p.get("deck")
         if d:
             lines.append(
