@@ -80,6 +80,18 @@ def build_node(home: str, cfg=None):
     # install the tracer first so node assembly itself is traceable
     cfg.tracing.apply()
     cfg.failpoints.apply()
+    # incident watchdog thresholds + the config fingerprint frozen
+    # into every snapshot (what this node was RUNNING when it fired)
+    cfg.incidents.apply(fingerprint={
+        "chain_id": cfg.base.chain_id,
+        "moniker": cfg.base.moniker,
+        "verifier": cfg.crypto.verifier,
+        "verify_plane": cfg.verify_plane.enable,
+        "mesh": cfg.verify_plane.mesh,
+        "pipeline_flights": cfg.verify_plane.pipeline_flights,
+        "mempool_admission": cfg.mempool.admission,
+        "tracing": cfg.tracing.enable,
+    })
     cfgdir = os.path.join(home, "config")
     doc = GenesisDoc.from_file(os.path.join(cfgdir, "genesis.json"))
     pa = cfg.base.proxy_app

@@ -304,6 +304,38 @@ class TracingConfig:
 
 
 @dataclass
+class IncidentsConfig:
+    """The incident flight recorder (libs/incidents.py). ALWAYS ON —
+    there is no enable knob, only thresholds: the recorder's poke path
+    costs a clock read + integer compares per consensus step, and the
+    snapshot only allocates when a trigger actually fires. Knob costs:
+    lowering commit_stall_s / round_limit makes drills fire earlier
+    (more ring churn, same per-poke cost); cooldown_s bounds how often
+    one persistent condition re-freezes."""
+
+    commit_stall_s: float = 20.0   # no commit for this long => incident
+    round_limit: int = 4           # a height reaching this round fires
+    breaker_flaps: int = 4         # breaker transitions inside window_s
+    shed_storm: int = 256          # sheddable-lane sheds inside window_s
+    window_s: float = 10.0         # flap/storm evaluation window
+    cooldown_s: float = 30.0       # per-trigger-kind re-arm time
+
+    def apply(self, fingerprint=None) -> None:
+        from cometbft_tpu.libs import incidents
+
+        incidents.configure(
+            commit_stall_s=self.commit_stall_s,
+            round_limit=self.round_limit,
+            breaker_flaps=self.breaker_flaps,
+            shed_storm=self.shed_storm,
+            window_s=self.window_s,
+            cooldown_s=self.cooldown_s,
+        )
+        if fingerprint is not None:
+            incidents.recorder().set_fingerprint(fingerprint)
+
+
+@dataclass
 class FailpointsConfig:
     """Deterministic fault injection (libs/failpoints.py). `spec` uses
     the same syntax as the CBT_FAILPOINTS env var:
@@ -331,6 +363,7 @@ class Config:
         default_factory=VerifyPlaneConfig)
     lightgate: LightGateConfig = field(default_factory=LightGateConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    incidents: IncidentsConfig = field(default_factory=IncidentsConfig)
     failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
 
     def validate_basic(self) -> None:
@@ -409,6 +442,15 @@ class Config:
             raise ConfigError("[mempool] retry_after_ms must be >= 0")
         if self.tracing.buffer < 16:
             raise ConfigError("[tracing] buffer must be >= 16 events")
+        inc = self.incidents
+        for name in ("commit_stall_s", "window_s", "cooldown_s"):
+            if getattr(inc, name) < 0:
+                raise ConfigError(f"[incidents] {name} must be >= 0")
+        if inc.round_limit < 1 or inc.breaker_flaps < 1 \
+                or inc.shed_storm < 1:
+            raise ConfigError(
+                "[incidents] round_limit/breaker_flaps/shed_storm "
+                "must be >= 1")
         if self.failpoints.spec:
             # parse-validate without arming: a typo'd spec must fail at
             # config load, not silently never fire
@@ -440,7 +482,8 @@ def _render(cfg: Config) -> str:
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
         ("lightgate", cfg.lightgate),
-        ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
+        ("tracing", cfg.tracing), ("incidents", cfg.incidents),
+        ("failpoints", cfg.failpoints),
     ]:
         out.append(f"[{section}]")
         for k, val in vars(obj).items():
@@ -463,7 +506,8 @@ def load_config(path: str) -> Config:
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
         ("lightgate", cfg.lightgate),
-        ("tracing", cfg.tracing), ("failpoints", cfg.failpoints),
+        ("tracing", cfg.tracing), ("incidents", cfg.incidents),
+        ("failpoints", cfg.failpoints),
     ]:
         for k, val in doc.get(section, {}).items():
             if not hasattr(obj, k):

@@ -25,13 +25,26 @@ class HeightVoteSet:
         self._lock = threading.Lock()
         self._rounds: Dict[int, Dict[int, VoteSet]] = {}
         self.round = 0
+        # propagated to every VoteSet (existing + lazily created): the
+        # verify-plane flush-seq observer the height ledger joins on
+        self.on_flush = None
         self.set_round(0)
+
+    def set_on_flush(self, fn) -> None:
+        """Install the flush-seq observer on every vote set of this
+        height — the rounds already allocated AND the ones
+        _ensure_round creates later."""
+        with self._lock:
+            self.on_flush = fn
+            for sets in self._rounds.values():
+                for vs in sets.values():
+                    vs.on_flush = fn
 
     def _ensure_round(self, round_: int) -> None:
         """Allocate vote sets for round_ WITHOUT advancing self.round —
         peer catch-up allocation must not ratchet the round bound."""
         if round_ not in self._rounds:
-            self._rounds[round_] = {
+            sets = {
                 canonical.PREVOTE_TYPE: VoteSet(
                     self.chain_id, self.height, round_,
                     canonical.PREVOTE_TYPE, self.valset,
@@ -42,6 +55,9 @@ class HeightVoteSet:
                     ext_enabled=self.ext_enabled,
                 ),
             }
+            for vs in sets.values():
+                vs.on_flush = self.on_flush
+            self._rounds[round_] = sets
 
     def set_round(self, round_: int) -> None:
         """Advance the consensus round; only the engine entering a new

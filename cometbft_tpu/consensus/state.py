@@ -25,9 +25,11 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from cometbft_tpu.consensus import heightledger
 from cometbft_tpu.consensus import wal as walmod
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
 from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import incidents
 from cometbft_tpu.libs import tracing
 from cometbft_tpu.consensus.ticker import (
     ManualTicker,
@@ -84,6 +86,12 @@ STEP_NAMES = {
     STEP_PREVOTE_WAIT: "prevote_wait", STEP_PRECOMMIT: "precommit",
     STEP_PRECOMMIT_WAIT: "precommit_wait", STEP_COMMIT: "commit",
 }
+
+# the height ledger keeps its own numeric copies of the step ids it
+# stamps (import-lightness); they must never drift from this module's
+assert heightledger.STEP_PREVOTE == STEP_PREVOTE
+assert heightledger.STEP_PRECOMMIT == STEP_PRECOMMIT
+assert heightledger.STEP_COMMIT == STEP_COMMIT
 
 
 @dataclass
@@ -164,12 +172,20 @@ class ConsensusState(BaseService):
         self._step_entered_at = 0.0  # real-clock step-duration anchor
         # set when a SimulatedCrash failpoint killed the machine
         self.crashed = False
+        # always-on per-height commit-latency ledger (/dump_heights);
+        # written from _set_step transitions + finalize on the receive
+        # routine, stamps on the ledger clock (virtual under simnet)
+        self.height_ledger = heightledger.HeightLedger()
 
     # ---------------------------------------------------------------------
     # service lifecycle
     # ---------------------------------------------------------------------
 
     def on_start(self) -> None:
+        # register as THE process height ledger (/dump_heights, metric
+        # sampling, incident snapshots); the _LAST half of the pattern
+        # keeps history served after stop, like the verify plane's
+        heightledger.set_global_ledger(self.height_ledger)
         if self._wal_path:
             self._catchup_replay()
         self._thread = threading.Thread(
@@ -180,6 +196,7 @@ class ConsensusState(BaseService):
         self._schedule_round0()
 
     def on_stop(self) -> None:
+        heightledger.clear_global_ledger(self.height_ledger)
         self.ticker.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
@@ -189,12 +206,20 @@ class ConsensusState(BaseService):
     def _schedule_round0(self) -> None:
         self.internal_queue.put(("start_round", self.height, 0))
 
-    @staticmethod
-    def _new_height_vote_set(state: State, height: int) -> HeightVoteSet:
-        return HeightVoteSet(
+    def _new_height_vote_set(self, state: State,
+                             height: int) -> HeightVoteSet:
+        hvs = HeightVoteSet(
             state.chain_id, height, state.validators,
             ext_enabled=state.consensus_params.extensions_enabled(height),
         )
+        # flush-seq join: vote submissions that rode the verify plane
+        # report the flush that served them, so /dump_heights can
+        # attribute per-height verify-plane ms against /dump_flushes
+        hvs.set_on_flush(self._note_plane_flush)
+        return hvs
+
+    def _note_plane_flush(self, seq: int) -> None:
+        self.height_ledger.note_flush_seq(seq)
 
     def reset_to_state(self, state: State) -> None:
         """Adopt a state produced by a sync path (blocksync/statesync)
@@ -245,6 +270,15 @@ class ConsensusState(BaseService):
             )
         self._step_entered_at = now
         self.step = step
+        # always-on height ledger: stamp the stage this transition
+        # enters (ledger clock — virtual under simnet) and anchor the
+        # per-height WAL fsync attribution once per height; then poke
+        # the incident watchdog (commit-stall/round-escalation checks
+        # are a clock read + integer compares when nothing is wrong)
+        self.height_ledger.on_step(self.height, self.round, step)
+        if self.wal is not None:
+            self.height_ledger.note_wal_fsync_base(self.wal.fsync_led_ns)
+        incidents.poke(self.height, self.round)
         tracing.instant(
             "consensus.step", cat="consensus", height=self.height,
             round=self.round, step=STEP_NAMES.get(step, str(step)),
@@ -831,6 +865,13 @@ class ConsensusState(BaseService):
                          vote.validator_address.hex()[:12], e)
             return
         if added:
+            if vote.vote_type == canonical.PRECOMMIT_TYPE:
+                # late-signer attribution: the validator's FIRST
+                # precommit arrival of each round, stamped BEFORE the
+                # quorum transitions below so the quorum-crossing vote
+                # itself never reads as late
+                self.height_ledger.note_vote(vote.round,
+                                             vote.validator_index)
             if self.on_vote_added is not None:
                 try:
                     # reactor hook: broadcast HasVote so peers stop
@@ -941,6 +982,7 @@ class ConsensusState(BaseService):
     def _finalize_commit_inner(self, height: int, block_id: BlockID,
                                block: Block) -> None:
         fp.fail_point("consensus.pre_finalize")
+        self.height_ledger.on_commit(height)  # t_commit: persist begins
         precommits = self.votes.precommits(self.commit_round)
         ext_commit = None
         if self.state.consensus_params.extensions_enabled(height):
@@ -958,6 +1000,8 @@ class ConsensusState(BaseService):
         )
         self.state = new_state
         self._update_metrics(block)
+        self._record_height(height, block, seen_commit,
+                            heightledger.VIA_CONSENSUS)
         self._advance_to_height(new_state)
 
     def _apply_commit_block(self, block: Block, commit: Commit) -> None:
@@ -1006,7 +1050,30 @@ class ConsensusState(BaseService):
         )
         self.state = new_state
         self._update_metrics(block)
+        self._record_height(commit.height, block, commit,
+                            heightledger.VIA_CATCHUP)
         self._advance_to_height(new_state)
+
+    def _record_height(self, height: int, block: Block, commit,
+                       via: str) -> None:
+        """Close the height in the ledger (stage timeline, late-signer
+        offsets + absent bitmap from the commit, plane/WAL joins) and
+        re-arm the incident watchdog's commit-stall timer. Failure-
+        isolated: observability must never halt finalization."""
+        try:
+            self.height_ledger.record_height(
+                height,
+                commit_round=getattr(commit, "round", self.commit_round),
+                proposer_hex=block.header.proposer_address.hex()[:12],
+                n_txs=len(block.data.txs),
+                block_bytes=sum(len(t) for t in block.data.txs),
+                commit_sigs=commit.signatures,
+                fsync_led_ns=self.wal.fsync_led_ns if self.wal else 0,
+                via=via,
+            )
+        except Exception:  # noqa: BLE001 - ledger bug != consensus halt
+            _log.exception("height ledger record failed at h=%d", height)
+        incidents.note_commit(height)
 
     def _update_metrics(self, block: Optional[Block]) -> None:
         m = self.metrics

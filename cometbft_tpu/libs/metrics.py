@@ -225,6 +225,39 @@ class NodeMetrics:
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 3, 10),
             labeled=True,  # step=... only; no bare idle series
         )
+        # height ledger (consensus/heightledger.py): stage-timeline
+        # percentiles over the bounded per-height ring, sampled at
+        # scrape time (stage=proposal|prevote_quorum|precommit_quorum|
+        # commit|apply, q=p50|p90|p99|max — cumulative ms from height
+        # entry; apply IS the commit latency)
+        self.height_stage = r.gauge(
+            "consensus", "height_stage_ms",
+            "Per-height commit-latency stage percentiles over the "
+            "height-ledger window (labels: stage, q)")
+        self.height_ledger_size = r.gauge(
+            "consensus", "height_ledger_records",
+            "Height records currently held by the bounded height "
+            "ledger ring")
+        # late-signer attribution: sampled at scrape time from the
+        # height ledger's bounded chronic table — TOP-K offenders only,
+        # so a 10k-validator set can never explode the label
+        # cardinality (the full table is in /dump_heights)
+        self.late_signers = r.counter(
+            "consensus", "late_signer_heights_total",
+            "Heights on which a validator's precommit arrived after "
+            "the quorum instant (kind=late) or was absent from the "
+            "commit (kind=absent), labeled val=<validator index>; "
+            "top-K chronic offenders sampled at scrape time")
+        # incident flight recorder (libs/incidents.py), sampled at
+        # scrape time from the process-global recorder
+        self.incidents_fired = r.counter(
+            "incidents", "fired_total",
+            "Incident snapshots frozen by the watchdog, labeled by "
+            "trigger (commit_stall|round_escalation|breaker_flap|"
+            "shed_storm|forced)")
+        self.incidents_ring = r.gauge(
+            "incidents", "ring_records",
+            "Incident snapshots currently held by the bounded ring")
         # device verifier (TPU-native addition)
         self.verify_batches = r.counter(
             "crypto", "verify_batches_total",
@@ -564,6 +597,40 @@ class NodeMetrics:
             self.wal_fsync._set((), float(fs["count"]))
             self.wal_fsync_seconds._set((), float(fs["seconds"]))
         except Exception:  # noqa: BLE001
+            pass
+        try:
+            # height ledger (module-loaded-only like the plane: the
+            # ledger belongs to whichever consensus engine registered
+            # last — same _LAST caveat as the flush percentiles)
+            hl = sys.modules.get("cometbft_tpu.consensus.heightledger")
+            led = hl and hl.global_ledger()
+            if led is not None:
+                s = led.summary()
+                self.height_ledger_size.set(float(s.get("heights", 0)))
+                if s.get("heights"):
+                    for stage, qs in s["stage_ms"].items():
+                        for q, v in qs.items():
+                            self.height_stage.set(
+                                float(v), stage=stage, q=q)
+                for row in led.top_late_signers():
+                    key = str(row["val"])
+                    self.late_signers._set(
+                        (("kind", "late"), ("val", key)),
+                        float(row["late_heights"]))
+                    self.late_signers._set(
+                        (("kind", "absent"), ("val", key)),
+                        float(row["absent_heights"]))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            from cometbft_tpu.libs import incidents
+
+            rec = incidents.recorder()
+            self.incidents_ring.set(float(len(rec)))
+            for kind, n in rec.fired.items():
+                self.incidents_fired._set((("trigger", kind),),
+                                          float(n))
+        except Exception:  # noqa: BLE001 - scrape must never fail
             pass
 
     def expose_text(self) -> str:

@@ -252,6 +252,15 @@ def monotonic_ns() -> int:
     return c() if c is not None else time.perf_counter_ns()
 
 
+def module_clock_installed() -> bool:
+    """True when a module-default clock is installed (the simnet's
+    virtual clock). Real-clock background pollers (the incident
+    watchdog ticker) gate on this: a wall-clock poke evaluated against
+    virtual-clock stamps would fire garbage incidents AND break simnet
+    replay determinism."""
+    return _CLOCK is not None
+
+
 def clock_gen() -> int:
     """Generation counter for :func:`monotonic_ns`'s clock domain.
     Holders of a stored stamp (the verify plane's submit-time

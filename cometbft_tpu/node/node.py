@@ -355,6 +355,12 @@ class Node(BaseService):
         self.switch.dial_peer(addr, persistent=persistent)
 
     def on_start(self) -> None:
+        # incident flight recorder: the real-clock watchdog ticker
+        # covers total wedges (no step transitions => no pokes) on
+        # live nodes; refcounted across nodes, inert under simnet
+        from cometbft_tpu.libs import incidents
+
+        incidents.recorder().start_watchdog()
         if self.verify_plane is not None:
             from cometbft_tpu import verifyplane
 
@@ -459,6 +465,9 @@ class Node(BaseService):
         self.consensus.start()
 
     def on_stop(self) -> None:
+        from cometbft_tpu.libs import incidents
+
+        incidents.recorder().stop_watchdog()
         if self.lightgate is not None:
             # before the plane stops: in-flight gateway verifies fall
             # back to the direct host path instead of racing the drain

@@ -581,6 +581,29 @@ class Routes:
             return plane.dump_flushes()
         return verifyplane.dump_flushes()
 
+    def dump_heights(self):
+        """The consensus height ledger: per-height commit-latency
+        stage timeline, verify-plane join, and late-signer attribution
+        (also served as GET /dump_heights). Always on like the flush
+        ledger, and survives the consensus engine stopping — the
+        module-global _LAST fallback serves post-mortem reads."""
+        from cometbft_tpu.consensus import heightledger
+
+        cs = getattr(self.node, "consensus", None)
+        led = getattr(cs, "height_ledger", None)
+        if led is not None:
+            return led.dump()
+        return heightledger.dump_heights()
+
+    def dump_incidents(self):
+        """The incident flight recorder's frozen snapshots (also GET
+        /dump_incidents): what tripped the watchdog (commit stall,
+        round escalation, breaker flap, shed storm), with the height/
+        flush/trace tails and counter sample frozen AT trigger time."""
+        from cometbft_tpu.libs import incidents
+
+        return incidents.dump_incidents()
+
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
 
@@ -669,7 +692,8 @@ _ROUTES = [
     "abci_info", "abci_query", "check_tx", "broadcast_evidence",
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
-    "block_search", "dump_traces", "dump_flushes",
+    "block_search", "dump_traces", "dump_flushes", "dump_heights",
+    "dump_incidents",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -715,6 +739,16 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def routes(self) -> Routes:
         return self.server.routes  # type: ignore[attr-defined]
+
+    def _send_json(self, obj) -> None:
+        """Serve a bare JSON document (the GET dump endpoints — no
+        JSON-RPC envelope)."""
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _reply(self, obj, rid=None):
         body = json.dumps(
@@ -775,27 +809,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
-        if url.path == "/dump_traces":
-            # perfetto-loadable Chrome trace JSON of the current ring
-            # (node/node.go:846's prometheus sibling, for spans)
-            from cometbft_tpu.libs import tracing
-
-            body = json.dumps(tracing.export_chrome()).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
-        if url.path == "/dump_flushes":
-            # the verify plane's always-on flush ledger (PR 6): what
-            # the last few hundred flushes cost, no tracing knob needed
-            body = json.dumps(self.routes.dump_flushes()).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+        # observability dumps served as bare GET paths (the curl
+        # surface next to /metrics): traces (perfetto-loadable),
+        # the always-on flush/height ledgers, incident snapshots
+        if url.path in ("/dump_traces", "/dump_flushes",
+                        "/dump_heights", "/dump_incidents"):
+            self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):
             # profiling endpoints (node/node.go:867-881 pprof server +

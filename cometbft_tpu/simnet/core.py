@@ -241,6 +241,13 @@ class SimNode:
             cs = self.node.consensus
             cs.ticker = SimTicker(self.net, self)
             cs.on_evidence = self._gossip_own_evidence
+            # on_start is bypassed (the scheduler owns execution), so
+            # register the height ledger here: incident snapshots and
+            # /dump_heights read the module global — last-started node
+            # wins, which is deterministic under the scheduler
+            from cometbft_tpu.consensus import heightledger
+
+            heightledger.set_global_ledger(cs.height_ledger)
             # mark the service running without spawning its thread: the
             # scheduler pumps the queues the thread would have drained
             with cs._lock:

@@ -29,7 +29,9 @@ class SimnetFailure(AssertionError):
     that phase structure."""
 
     def __init__(self, msg: str, seed: int, schedule: List[Dict],
-                 include_ledger: bool = True):
+                 include_ledger: bool = True,
+                 include_heights: bool = True,
+                 include_incidents: bool = True):
         self.seed = seed
         self.schedule = schedule
         text = msg
@@ -51,6 +53,21 @@ class SimnetFailure(AssertionError):
         led_tail = verifyplane.ledger_tail(8) if include_ledger else []
         if led_tail:
             text += "\nflush ledger tail: " + " | ".join(led_tail)
+        # the always-on height ledger: where the last commits' latency
+        # went (stage timeline on the virtual clock) — same move-mark
+        # gating as the flush ledger
+        from cometbft_tpu.consensus import heightledger
+        from cometbft_tpu.libs import incidents
+
+        h_tail = heightledger.ledger_tail(8) if include_heights else []
+        if h_tail:
+            text += "\nheight ledger tail: " + " | ".join(h_tail)
+        # incidents frozen DURING this simulation (commit stalls, round
+        # escalations, ...) are first-class replay evidence
+        inc_tail = incidents.incident_tail(4) if include_incidents \
+            else []
+        if inc_tail:
+            text += "\nincidents: " + " | ".join(inc_tail)
         # the replay blob stays LAST: consumers (and the fuzzer) parse
         # everything after "replay:" as one JSON document
         text += f"\nreplay: {schedule_to_json(seed, schedule)}"
@@ -76,11 +93,16 @@ class Simnet:
         # many val txs were injected) — the churn soak asserts the
         # rotation stream replays byte-identically
         self.epoch_results: List[Dict] = []
-        # flush-ledger position at sim start: failure blobs attach the
-        # ledger tail only if it advanced during THIS simulation
+        # flush-/height-ledger + incident positions at sim start:
+        # failure blobs attach each tail only if it advanced during
+        # THIS simulation
         from cometbft_tpu import verifyplane
+        from cometbft_tpu.consensus import heightledger
+        from cometbft_tpu.libs import incidents
 
         self._ledger_mark = verifyplane.ledger_mark()
+        self._height_mark = heightledger.ledger_mark()
+        self._incident_mark = incidents.incident_mark()
 
     # -- running -----------------------------------------------------------
 
@@ -382,10 +404,16 @@ class Simnet:
 
     def _fail(self, msg: str) -> "SimnetFailure":
         from cometbft_tpu import verifyplane
+        from cometbft_tpu.consensus import heightledger
+        from cometbft_tpu.libs import incidents
 
         return SimnetFailure(
             msg, self.net.seed, self.schedule,
             include_ledger=verifyplane.ledger_advanced(self._ledger_mark),
+            include_heights=heightledger.ledger_advanced(
+                self._height_mark),
+            include_incidents=incidents.incident_advanced(
+                self._incident_mark),
         )
 
     def commit_hashes(self) -> List[Dict[int, bytes]]:

@@ -92,6 +92,7 @@ class PsqlEventSink:
         self.conn = conn
         self.chain_id = chain_id
         self._p = paramstyle
+        self._sqlite = sqlite_dialect
         self._lock = threading.Lock()
         schema, views = SCHEMA, VIEWS
         if sqlite_dialect:
@@ -141,7 +142,14 @@ class PsqlEventSink:
     def _insert_returning(self, cur, sql: str, params) -> int:
         """INSERT and return the new rowid via RETURNING — correct
         under concurrent writers (SELECT MAX(rowid) after INSERT races
-        with other connections and can adopt someone else's row)."""
+        with other connections and can adopt someone else's row). The
+        sqlite dialect uses cursor.lastrowid instead: RETURNING only
+        landed in sqlite 3.35 (this container ships 3.34), and
+        lastrowid is per-connection so it carries no cross-writer race
+        — the hazard the RETURNING form exists to close on postgres."""
+        if self._sqlite:
+            cur.execute(self._q(sql), params)
+            return cur.lastrowid
         cur.execute(self._q(sql + " RETURNING rowid"), params)
         return cur.fetchone()[0]
 

@@ -73,6 +73,10 @@ class VoteSet:
         self.verify_plane = None
         self._plane_groups: Dict[bytes, object] = {}
         self._valset_cols = None  # (pubs tuple, powers tuple), lazy
+        # flush-seq observer: called with the verify-plane flush-ledger
+        # seq that served an admitted vote (the consensus height
+        # ledger's /dump_flushes join key); None = nobody listening
+        self.on_flush = None
 
     def size(self) -> int:
         return len(self.valset)
@@ -242,6 +246,13 @@ class VoteSet:
             # plane stopped/saturated mid-call: serial host fallback
             with self._lock:
                 return self._add_vote(vote, True)
+        if self.on_flush is not None and fut.flush_seq is not None:
+            # report which flush served this vote (valid or not — the
+            # plane paid for it either way) for per-height attribution
+            try:
+                self.on_flush(fut.flush_seq)
+            except Exception:  # noqa: BLE001 - observer must not veto
+                pass
 
         if not verdicts[0]:
             raise VoteSetError("invalid vote: invalid signature")

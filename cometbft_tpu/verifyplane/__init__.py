@@ -22,6 +22,7 @@ from cometbft_tpu.verifyplane.plane import (
     VerifyPlane,
     clear_global_plane,
     dump_flushes,
+    flush_stats_for_seqs,
     global_plane,
     ledger_advanced,
     ledger_mark,
@@ -58,6 +59,7 @@ __all__ = [
     "notify_next_valset",
     "set_global_warmer",
     "dump_flushes",
+    "flush_stats_for_seqs",
     "global_plane",
     "ledger_advanced",
     "ledger_mark",

@@ -341,11 +341,18 @@ class PlaneStopped(PlaneError):
 
 class VerifyFuture:
     """Resolves to a tuple of per-item bool verdicts (one submission may
-    carry several signatures, e.g. a vote + its extension)."""
+    carry several signatures, e.g. a vote + its extension).
 
-    __slots__ = ("_ev", "_verdicts", "_err")
+    ``flush_seq`` is the flush-ledger seq of the flush that served this
+    submission (stamped at stage time, before the future resolves) —
+    None until staged, and forever None for shed/failed submissions.
+    The consensus height ledger joins it against /dump_flushes to
+    attribute per-height verify-plane milliseconds."""
+
+    __slots__ = ("_ev", "_verdicts", "_err", "flush_seq")
 
     def __init__(self):
+        self.flush_seq: Optional[int] = None
         self._ev = threading.Event()
         self._verdicts: Optional[Tuple[bool, ...]] = None
         self._err: Optional[BaseException] = None
@@ -688,6 +695,9 @@ class VerifyPlane:
         if settle:
             rows = [r for sub in settle for r in sub.rows]
             t0 = tracing.monotonic_ns()
+            drain_seq = next(self._flush_seq)
+            for sub in settle:
+                sub.future.flush_seq = drain_seq
             verdicts = _host_verdicts(rows)
             t1 = tracing.monotonic_ns()
             self._settle(settle, verdicts)
@@ -698,7 +708,7 @@ class VerifyPlane:
             g_rows = sum(len(s.rows) for s in settle
                          if s.lane == LANE_GATEWAY)
             self.ledger.record([
-                next(self._flush_seq), round(t0 / 1e6, 3), len(rows),
+                drain_seq, round(t0 / 1e6, 3), len(rows),
                 len(settle), 0.0, 0.0, 0.0,
                 round((t1 - t0) / 1e6, 3),
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
@@ -824,6 +834,11 @@ class VerifyPlane:
             self.sheds[lane] += n
         if self.metrics is not None:
             self.metrics.plane_shed.inc(n, lane=lane)
+        # incident watchdog: sheds feed the storm window (counted here,
+        # evaluated at the next deterministic poke — libs/incidents)
+        from cometbft_tpu.libs import incidents
+
+        incidents.note_shed(n)
 
     def submit_and_wait(self, pubs, msgs, sigs,
                         timeout: Optional[float] = None,
@@ -1196,6 +1211,10 @@ class VerifyPlane:
                PATH_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
                0, 0, t0, t0, gen]
+        for s in batch:
+            # the join key consumers read AFTER the future resolves
+            # (height ledger -> /dump_flushes attribution)
+            s.future.flush_seq = led[_L_SEQ]
         if not tracing.enabled():
             # disabled fast path: no O(batch) span-arg computation on
             # the dispatcher hot path
@@ -1548,6 +1567,31 @@ def ledger_tail(n: int = 8) -> List[str]:
     blobs next to the trace tail)."""
     p = _GLOBAL or _LAST
     return [] if p is None else p.ledger.tail(n)
+
+
+def flush_stats_for_seqs(seqs) -> dict:
+    """Join a set of flush-ledger seqs against the ledger ring: the
+    summed WORK milliseconds (pack+flight+collect+settle — queued_ms is
+    coalescing wait, not verify-plane work), how many flushes matched,
+    and how many of the matched fused flushes paid a COLD table build
+    inline. The consensus height ledger calls this once per height to
+    attribute verify-plane time; a seq already rotated out of the
+    bounded ring simply doesn't contribute (honest undercount, never a
+    guess)."""
+    p = _GLOBAL or _LAST
+    out = {"ms": 0.0, "flushes": 0, "cold": 0}
+    if p is None or not seqs:
+        return out
+    for r in list(p.ledger._ring):
+        if r[_L_SEQ] in seqs:
+            out["ms"] += (r[_L_PACK] + r[_L_FLIGHT] + r[_L_COLLECT]
+                          + r[_L_SETTLE])
+            out["flushes"] += 1
+            if r[_L_PATH] in (PATH_FUSED, PATH_FUSED_SHARDED) \
+                    and not r[_L_WARM]:
+                out["cold"] += 1
+    out["ms"] = round(out["ms"], 3)
+    return out
 
 
 def ledger_mark() -> tuple:

@@ -311,6 +311,21 @@ def test_metrics_lint_nodemetrics_clean():
     assert lint_node_metrics() == []
 
 
+def test_metrics_lint_sample_coverage_detects_undeclared():
+    """The registry cross-check (ISSUE 13 satellite): a _sample body
+    writing into a family never declared in NodeMetrics.__init__ must
+    be flagged — its AttributeError would otherwise be swallowed by
+    the sampler's fault isolation and the family would silently never
+    scrape. The real _sample must pass clean (covered by the
+    lint_node_metrics test above, which now includes this check)."""
+    from tools.metrics_lint import _sample_coverage
+
+    out = _sample_coverage(
+        "self.ghost_family.set(1.0)\nself.height_stage.set(0.0)")
+    assert any("ghost_family" in v for v in out), out
+    assert not any("height_stage" in v for v in out), out
+
+
 def test_metrics_lint_catches_violations():
     from tools.metrics_lint import lint_registry
 

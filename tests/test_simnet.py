@@ -254,6 +254,112 @@ def test_flush_ledger_deterministic_with_deck_enabled(tmp_path):
                and r["dev0"] == 0 for r in a)
 
 
+def test_height_ledger_deterministic_under_simnet(tmp_path):
+    """ISSUE 13 acceptance: the always-on height ledger rides the
+    virtual clock — the same (seed, schedule) produces byte-identical
+    per-height records on every node (stage timeline, rounds, late
+    offsets, absent bitmaps — everything), with a verify plane RUNNING
+    so the flush-seq join is exercised too. Also proves the ledger is
+    on by default and that the plane join attributes real flushes."""
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+    def run_once(tag):
+        plane = VerifyPlane(window_ms=0.5, use_device=False)
+        plane.start()
+        set_global_plane(plane)
+        try:
+            with Simnet(3, seed=61, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(
+                    [{"at": 0.1, "op": "link", "drop": 0.03,
+                      "delay": 0.01}],
+                    until_height=3, max_time=60.0,
+                )
+                sim.assert_safety()
+                recs = [n.node.consensus.height_ledger.records()
+                        for n in sim.net.nodes]
+        finally:
+            set_global_plane(None)
+            plane.stop()
+        for node_recs in recs:
+            assert node_recs, "height ledger recorded nothing"
+        return recs
+
+    a = run_once("a")
+    b = run_once("b")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # the stamps really rode the virtual clock, and the plane join
+    # attributed at least one flush somewhere on the run
+    from cometbft_tpu.simnet.core import SIM_EPOCH_SECONDS
+
+    flat = [r for node_recs in a for r in node_recs]
+    assert all(r["ts_ms"] >= SIM_EPOCH_SECONDS * 1e3 for r in flat)
+    assert any(r["plane_flushes"] > 0 for r in flat), \
+        "no height ever joined a verify-plane flush"
+    assert all(r["apply_ms"] >= r["commit_ms"] >= 0 for r in flat)
+
+
+def test_incident_stream_deterministic_under_simnet(tmp_path):
+    """ISSUE 13 acceptance: a partition-induced commit stall fires a
+    commit_stall incident (plus round escalation), and the same (seed,
+    schedule) freezes a byte-identical incident stream — the snapshot
+    bundles (height/flush tails, counter samples, virtual timestamps)
+    included."""
+    from cometbft_tpu.libs import incidents
+
+    def run_once(tag):
+        rec = incidents.IncidentRecorder(
+            commit_stall_s=3.0, round_limit=3, cooldown_s=5.0)
+        old = incidents.install(rec)
+        try:
+            with Simnet(4, seed=71, basedir=str(tmp_path / tag)) as sim:
+                sim.run([], until_height=2, max_time=60.0)
+                cut = sim.net.now
+                # 2/2 split: NO quorum anywhere — commits stop, rounds
+                # escalate, and every step transition pokes the watchdog
+                sim.run([{"at": cut, "op": "partition",
+                          "groups": [[0, 1], [2, 3]]},
+                         {"at": cut + 12.0, "op": "heal"}],
+                        max_time=14.0)
+                assert sim.run([], until_height=3, max_time=60.0), \
+                    "chain did not recover after heal"
+                sim.assert_safety()
+                return rec.dump()
+        finally:
+            incidents.install(old)
+
+    a = run_once("a")
+    b = run_once("b")
+    assert a["fired"].get("commit_stall", 0) >= 1, a["fired"]
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    snap = next(s for s in a["incidents"]
+                if s["trigger"] == "commit_stall")
+    assert snap["detail"]["stalled_s"] >= 3.0
+    assert snap["height_tail"], "no height tail frozen in the snapshot"
+
+
+def test_failure_blob_carries_incident_and_height_tails():
+    """A SimnetFailure raised while incidents/heights were recorded
+    attaches their tails ABOVE the replay blob (which must stay last
+    and parseable) — the flush-ledger-tail contract extended to the
+    flight recorder."""
+    from cometbft_tpu.libs import incidents
+
+    rec = incidents.IncidentRecorder(cooldown_s=0.0)
+    old = incidents.install(rec)
+    try:
+        fp.registry().arm_from_spec("incidents.force=raise*1")
+        incidents.poke(height=9, round_=2)
+        msg = str(SimnetFailure("boom", 5, [{"at": 0.1, "op": "heal"}]))
+    finally:
+        incidents.install(old)
+        fp.reset()
+    assert "incidents: #0 forced h=9 r=2" in msg
+    # the replay blob is still the LAST line and parses
+    replay = msg.rsplit("replay: ", 1)[1]
+    doc = json.loads(replay)
+    assert doc["seed"] == 5
+
+
 def test_light_client_attack_evidence_committed(tmp_path):
     """A >=1/3 coalition's forged header reaches one honest node as
     LightClientAttackEvidence (with its conflicting-commit proof),

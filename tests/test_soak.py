@@ -304,6 +304,72 @@ def test_churn_soak_10k_scale(tmp_path):
         assert rotated_in == rec["in"] and not rotated_out
 
 
+# ---------------------------------------------------------------------------
+# Incident flight recorder under chaos (ISSUE 13 acceptance): a
+# partition-induced commit stall — WHILE the signed flood and a
+# dispatch fault fire — freezes a commit_stall incident whose whole
+# snapshot stream replays byte-identically from (seed, schedule).
+# ---------------------------------------------------------------------------
+
+
+def _run_incident_soak(basedir, seed: int = 3131):
+    from cometbft_tpu.libs import incidents
+
+    plane = VerifyPlane(window_ms=0.5, use_device=False,
+                        bulk_deadline_ms=250.0)
+    plane.start()
+    set_global_plane(plane)
+    rec = incidents.IncidentRecorder(
+        commit_stall_s=3.0, round_limit=3, cooldown_s=6.0)
+    old = incidents.install(rec)
+    try:
+        fp.registry().arm_from_spec("verifyplane.dispatch=raise*1")
+        with Simnet(4, seed=seed, basedir=str(basedir)) as sim:
+            # quorumless 2/2 partition mid-flood: commits stop DEAD —
+            # no side holds 2/3, the step machine wedges with no
+            # transitions at all, and the stall is detected at the
+            # first post-heal transition (the deterministic simnet
+            # evaluator; live nodes additionally have the real-clock
+            # watchdog ticker for exactly this wedge)
+            sched = [
+                {"at": 0.3, "op": "partition",
+                 "groups": [[0, 1], [2, 3]]},
+                {"at": 0.6, "op": "flood", "node": 0, "rate": 20.0,
+                 "duration": 4.0, "signed": True, "size": 24},
+                {"at": 9.0, "op": "heal"},
+            ]
+            assert sim.run(sched, until_height=4, max_time=90.0), \
+                "chain never recovered after the quorumless partition"
+            sim.assert_safety()
+            hashes = sim.commit_hashes()
+    finally:
+        incidents.install(old)
+        set_global_plane(None)
+        plane.stop()
+        fp.reset()
+    return hashes, rec.dump()
+
+
+def test_chaos_soak_commit_stall_incident_replays(tmp_path):
+    """The acceptance scenario: the partition-induced stall fires a
+    commit_stall incident with the height/flush tails frozen AT the
+    stall, and the same (seed, schedule) yields a byte-identical
+    incident stream AND chain."""
+    h1, d1 = _run_incident_soak(tmp_path / "a")
+    h2, d2 = _run_incident_soak(tmp_path / "b")
+    assert h1 == h2
+    assert d1["fired"].get("commit_stall", 0) >= 1, d1["fired"]
+    assert json.dumps(d1, sort_keys=True) == \
+        json.dumps(d2, sort_keys=True)
+    snap = next(s for s in d1["incidents"]
+                if s["trigger"] == "commit_stall")
+    # the black box froze real evidence: the last heights' stage
+    # timelines and the plane's last flushes (the flood was riding it)
+    assert snap["height_tail"], snap
+    assert snap["flush_tail"], snap
+    assert snap["counters"]["plane"]["rows"] > 0
+
+
 def test_flood_reaches_blocks(tmp_path):
     """Sustained-throughput sanity: flooded txs COMMIT — the accepted
     stream shows up in blocks, not just in mempool counters."""

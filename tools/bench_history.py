@@ -30,11 +30,18 @@ from bench import FULL_CONFIG_NAMES, load_bench_results  # noqa: E402
 # stable column order: the headline first, then the numbered configs
 _CFG_ORDER = re.compile(r"cfg(\d+)")
 
+# configs that embed the height ledger's commit-latency attribution
+# (bench extra.commit_p50_ms/commit_p99_ms via tools/height_report) —
+# each gets a "cfgN commit p50/p99" sub-row, all-'—' before the first
+# round that recorded it (the cfg10–13 precedent)
+_COMMIT_LATENCY_CFGS = ("cfg9", "cfg13")
+
 
 def _cfg_key(name: str):
     if name == "headline":
         return (0, 0, name)
     m = _CFG_ORDER.match(name)
+    # a "cfgN commit p50/p99" sub-row sorts right after its cfgN row
     return (1, int(m.group(1)) if m else 99, name)
 
 
@@ -77,6 +84,21 @@ def history(rounds: dict) -> dict:
                 "vs_baseline": res.get("vs_baseline") if res else None,
             })
         series[cfg] = pts
+        if cfg in _COMMIT_LATENCY_CFGS:
+            cpts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                p50_v = extra.get("commit_p50_ms")
+                p99_v = extra.get("commit_p99_ms")
+                cpts.append({
+                    "round": tag,
+                    "value": (f"{p50_v:g}/{p99_v:g}"
+                              if p50_v is not None and p99_v is not None
+                              else None),
+                    "unit": "ms p50/p99",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} commit"] = cpts
     deltas = []
     prev = None
     for tag in rounds:
@@ -96,6 +118,8 @@ def _fmt_val(pt: dict) -> str:
     v = pt["value"]
     if v is None:
         return "—"
+    if isinstance(v, str):  # pre-rendered (commit p50/p99 sub-rows)
+        return f"{v}{(' ' + pt['unit']) if pt['unit'] else ''}"
     if isinstance(v, float) and v >= 1000:
         v = round(v)
     return f"{v:g}{(' ' + pt['unit']) if pt['unit'] else ''}"

@@ -280,6 +280,22 @@ def run_inprocess(rate: float, duration: float, n_nodes: int = 4,
         "admission": nodes[0].mempool.admission.stats()
         if nodes[0].mempool.admission else None,
     }
+    # per-height commit-latency attribution from node 0's always-on
+    # height ledger (trimmed: the bench evidence file must not carry
+    # 512 full records) — cfg9 embeds the height_report table so the
+    # sustained-load commit latency is baseline-comparable
+    try:
+        from tools import height_report
+
+        hd = nodes[0].consensus.height_ledger.dump()
+        hd["heights"] = hd["heights"][-64:]
+        rep = height_report.stage_report(hd)
+        extra["height_dump"] = hd
+        extra["height_stage_table"] = rep["stages"]
+        extra["commit_p50_ms"] = rep["commit_p50_ms"]
+        extra["commit_p99_ms"] = rep["commit_p99_ms"]
+    except Exception as e:  # noqa: BLE001 - report, don't kill the run
+        extra["height_dump_error"] = repr(e)[:200]
     if vplane is not None:
         ps = vplane.stats()
         extra["plane"] = {"lane_rows": ps["lane_rows"],

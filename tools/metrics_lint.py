@@ -48,11 +48,53 @@ def lint_registry(registry) -> List[str]:
     return out
 
 
-def lint_node_metrics() -> List[str]:
-    """Lint the full node metric set (the registry every node serves)."""
+def lint_sample_coverage() -> List[str]:
+    """Cross-check NodeMetrics._sample against a LIVE registry expose:
+    every ``self.<attr>`` the sampler touches must be a registered
+    metric whose family actually appears in expose_text(). Catches the
+    drive-by failure mode the naming lint cannot: a scrape-time sampler
+    writing into an attribute that was never declared in __init__ (the
+    AttributeError would be swallowed by _sample's per-group fault
+    isolation, so the family would silently never scrape)."""
+    return _sample_coverage(None)
+
+
+def _sample_coverage(src) -> List[str]:
+    """Inner body of :func:`lint_sample_coverage`; `src` overrides the
+    inspected _sample source (tests inject a synthetic sampler body to
+    prove the undeclared-family detection actually detects)."""
+    import inspect
+    import re
+
     from cometbft_tpu.libs.metrics import NodeMetrics
 
-    return lint_registry(NodeMetrics().registry)
+    nm = NodeMetrics()
+    exposed = nm.expose_text()  # runs _sample() against live modules
+    if src is None:
+        src = inspect.getsource(NodeMetrics._sample)
+    out: List[str] = []
+    for attr in sorted(set(re.findall(r"self\.(\w+)\.", src))):
+        m = getattr(nm, attr, None)
+        if m is None:
+            out.append(f"_sample writes self.{attr}: never declared "
+                       f"in NodeMetrics.__init__")
+            continue
+        name = getattr(m, "name", None)
+        if name is None:
+            out.append(f"_sample writes self.{attr}: not a Metric")
+            continue
+        if f"\n{name}" not in exposed and not exposed.startswith(name):
+            out.append(f"{name}: sampled by _sample but absent from a "
+                       f"live registry expose")
+    return out
+
+
+def lint_node_metrics() -> List[str]:
+    """Lint the full node metric set (the registry every node serves):
+    naming conventions + sampler/registry coverage."""
+    from cometbft_tpu.libs.metrics import NodeMetrics
+
+    return lint_registry(NodeMetrics().registry) + lint_sample_coverage()
 
 
 def main() -> int:
