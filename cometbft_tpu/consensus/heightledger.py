@@ -35,6 +35,18 @@ bitmap from the commit itself are folded into the record AND a bounded
 chronically-late table (top-K served in /dump_heights, sampled as
 ``consensus_late_signer_heights_total{val,kind}``). This is the column
 the DCN round will use to tell slow HOSTS from slow curves.
+
+The network-vs-crypto split (ISSUE 14): each late offset decomposes
+into ``net_ms`` (time the precommit spent in flight — receive instant
+minus the vote's own signing timestamp, both on ``Timestamp.now()``'s
+clock: the simnet's virtual clock under simulation, wall time live)
+and ``sign_ms`` (the remainder: the vote was already late when it was
+SIGNED). Joined against the gossip observatory
+(``p2p/peerledger.py``), each late row also names the delivering hop
+and its duplicate-receipt count, so /dump_heights says not just WHO
+was late but WHERE the milliseconds went — the decomposition PAPERS.md
+"Performance of EdDSA and BLS Signatures in Committee-Based Consensus"
+shows dominates committee-scale commit latency.
 """
 from __future__ import annotations
 
@@ -55,6 +67,10 @@ MAX_TRACKED_SIGNERS = 4096
 MAX_ARRIVALS = 16384
 # top-K rows served in /dump_heights and sampled into /metrics
 TOP_K_LATE = 16
+# post-commit stragglers folded into a finalized record (one per
+# validator; the bound also caps the per-height signature-verify cost
+# the straggler admission pays on the consensus thread)
+MAX_STRAGGLERS = 64
 
 # record paths (interned consts, FlushLedger's PATH_* discipline)
 VIA_CONSENSUS = "consensus"   # the normal step machine decided it
@@ -78,6 +94,9 @@ _H_T0NS, _H_GEN, _H_FSYNC0, _H_ARRIVALS, _H_SEQS = 19, 20, 21, 22, 23
 STEP_PREVOTE = 4
 STEP_PRECOMMIT = 6
 STEP_COMMIT = 8
+# types/canonical.PRECOMMIT_TYPE, numerically for the same reason —
+# the peer-ledger vote-route join keys on it
+PRECOMMIT_TYPE = 2
 _STEP_SLOT = {
     STEP_PREVOTE: _H_PROPOSAL,     # proposal phase over (quorum forming)
     STEP_PRECOMMIT: _H_PREVOTE,    # +2/3 prevotes (or prevote timeout)
@@ -99,11 +118,14 @@ class HeightLedger:
     plane_flushes joined), tx count, block tx bytes, WAL fsync ms on
     the ledger clock, the cold-table flag (a joined fused flush paid a
     valset table build inline), the late list ([validator_index,
-    offset_ms] pairs, offset > 0 = precommit arrived AFTER the quorum
-    instant), absent precommit count, and the absent bitmap (hex,
-    validator-index order). Written by the consensus receive routine;
-    read by /dump_heights, scrape-time /metrics percentiles, incident
-    snapshots, and simnet replay blobs."""
+    offset_ms, net_ms, sign_ms, via] rows — offset > 0 = precommit
+    arrived AFTER the quorum instant, split into in-flight net_ms vs
+    signed-late sign_ms, ``via`` naming the delivering peer when the
+    gossip observatory saw the hop), absent precommit count, and the
+    absent bitmap (hex, validator-index order). Written by the
+    consensus receive routine; read by /dump_heights, scrape-time
+    /metrics percentiles, incident snapshots, and simnet replay
+    blobs."""
 
     FIELDS = ("height", "ts_ms", "rounds", "proposer", "via",
               "proposal_ms", "prevote_quorum_ms", "precommit_quorum_ms",
@@ -114,14 +136,25 @@ class HeightLedger:
     STAGES = ("proposal", "prevote_quorum", "precommit_quorum",
               "commit", "apply")
 
-    __slots__ = ("_ring", "_cur", "_late_heights", "_late_dropped")
+    __slots__ = ("_ring", "_cur", "_late_heights", "_late_dropped",
+                 "peer_ledger", "_last_commit")
 
     def __init__(self, capacity: int = HEIGHT_LEDGER_CAPACITY):
         self._ring = deque(maxlen=max(16, int(capacity)))
         self._cur: Optional[list] = None
-        # vidx -> [late_heights, absent_heights] (bounded; chronic table)
+        # straggler anchor for the JUST-finalized height:
+        # [height, raw quorum ns, clock gen, commit round, ring record,
+        #  vidx-seen set] — precommits that arrive after the node moved
+        # on are folded into the finalized record post-hoc
+        self._last_commit: Optional[list] = None
+        # vidx -> [late_heights, absent_heights, net_ms, sign_ms]
+        # (bounded; the chronic table — net/sign sums are what tell a
+        # slow HOST from a slow SIGNER across heights)
         self._late_heights: Dict[int, list] = {}
         self._late_dropped = 0
+        # the gossip observatory of the owning node (p2p/peerledger.py
+        # PeerLedger), wired by Node/SimNode; None = no hop attribution
+        self.peer_ledger = None
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -153,16 +186,81 @@ class HeightLedger:
         self._cur = cur
         return cur
 
-    def note_vote(self, round_: int, vidx: int) -> None:
+    def note_vote(self, round_: int, vidx: int,
+                  net_ns: int = 0) -> None:
         """First precommit arrival stamp for (round, validator). Called
-        by the receive routine AFTER a precommit was admitted."""
+        by the receive routine AFTER a precommit was admitted.
+        ``net_ns`` is the vote's in-flight time (receive instant minus
+        its signing timestamp, both on Timestamp.now()'s clock) — the
+        network half of the late-signer split."""
         cur = self._cur
         if cur is None:
             return
         arrivals = cur[_H_ARRIVALS]
         key = (round_, vidx)
         if key not in arrivals and len(arrivals) < MAX_ARRIVALS:
-            arrivals[key] = tracing.monotonic_ns()
+            arrivals[key] = (tracing.monotonic_ns(), net_ns)
+
+    def wants_straggler(self, height: int, round_: int,
+                        vidx: int) -> bool:
+        """Cheap predicate the consensus straggler admission runs
+        BEFORE paying a signature verify: True iff a precommit for
+        (height, round, vidx) would actually be folded — the height is
+        the last finalized one, the round is its commit round, the
+        validator has no late row yet, and the bound has room."""
+        lc = self._last_commit
+        return bool(lc is not None and lc[0] == height and lc[1]
+                    and lc[3] == round_ and vidx not in lc[5]
+                    and len(lc[4][_H_LATE]) < MAX_STRAGGLERS)
+
+    def burn_straggler(self, height: int, round_: int,
+                       vidx: int) -> None:
+        """Mark a straggler slot consumed WITHOUT folding a row — the
+        consensus admission calls this when the signature verify
+        FAILED, so a forged flood for one validator costs exactly one
+        verify per height (the docstring bound on wants_straggler) at
+        the price of that validator's attribution for the height."""
+        lc = self._last_commit
+        if lc is not None and lc[0] == height and lc[3] == round_:
+            lc[5].add(vidx)
+
+    def note_straggler(self, height: int, round_: int, vidx: int,
+                       net_ns: int = 0) -> None:
+        """A verified precommit for the JUST-FINALIZED height arrived
+        after the node moved on: fold its lateness into the finalized
+        record (same net/sign split + hop join as pre-finalize late
+        rows). Runs on the receive routine — single writer, like every
+        other ledger mutation."""
+        lc = self._last_commit
+        if lc is None or lc[0] != height or lc[3] != round_:
+            return
+        h, q_ns, gen, _cr, rec, seen = lc
+        if not q_ns or vidx in seen \
+                or tracing.clock_gen() != gen \
+                or len(rec[_H_LATE]) >= MAX_STRAGGLERS:
+            return
+        off = (tracing.monotonic_ns() - q_ns) / 1e6
+        if off <= 0.0:
+            return
+        seen.add(vidx)
+        net_ms = min(off, max(0.0, net_ns / 1e6))
+        via = ""
+        pled = self.peer_ledger
+        if pled is not None:
+            route = pled.vote_route(height, round_, PRECOMMIT_TYPE,
+                                    vidx)
+            if route is not None:
+                via = route[0]
+                if route[1]:
+                    via += f"+{route[1]}dup"
+        row = [vidx, round(off, 3), round(net_ms, 3),
+               round(off - net_ms, 3), via]
+        # the ring record is the SAME list object — the appended row is
+        # visible to every later dump/summary read; re-sort so the
+        # documented validator-index order survives straggler folds
+        rec[_H_LATE].append(row)
+        rec[_H_LATE].sort()
+        self._fold_chronic([row], [])
 
     def note_flush_seq(self, seq: int) -> None:
         """A verify-plane flush (by ledger seq) served one of this
@@ -245,18 +343,48 @@ class HeightLedger:
             cur[_H_COLD] = join["cold"]
 
         # late-signer offsets: the deciding round's precommit arrivals
-        # vs the quorum instant; absent bitmap from the commit itself
+        # vs the quorum instant, each split net_ms vs sign_ms and
+        # joined against the gossip observatory for the delivering hop;
+        # absent bitmap from the commit itself
         late: List[list] = []
         arrivals = cur[_H_ARRIVALS]
+        pled = self.peer_ledger
         if q_ns and same_gen and arrivals:
-            for (r, vidx), t_ns in arrivals.items():
+            for (r, vidx), (t_ns, net_ns) in arrivals.items():
                 if r != commit_round:
                     continue
                 off = (t_ns - q_ns) / 1e6
-                if off > 0.0:
-                    late.append([vidx, round(off, 3)])
+                if off <= 0.0:
+                    continue
+                # the split: lateness explained by flight time first
+                # (a backed-up send queue shows up HERE), remainder =
+                # the vote was already late when it was signed
+                net_ms = min(off, max(0.0, net_ns / 1e6))
+                via = ""
+                if pled is not None:
+                    route = pled.vote_route(height, commit_round,
+                                            PRECOMMIT_TYPE, vidx)
+                    if route is not None:
+                        via = route[0]
+                        if route[1]:
+                            via += f"+{route[1]}dup"
+                late.append([vidx, round(off, 3), round(net_ms, 3),
+                             round(off - net_ms, 3), via])
             late.sort()
         cur[_H_LATE] = late
+        # arm the straggler path: precommits for THIS height arriving
+        # after the node advances still attribute against its quorum
+        # instant (the reference folds them into the next LastCommit;
+        # this implementation drops them — but their lateness is the
+        # single most valuable late-signer signal, so the ledger
+        # stamps them into the finalized record post-hoc)
+        self._last_commit = [height, q_ns if same_gen else 0,
+                             cur[_H_GEN], commit_round, cur,
+                             {row[0] for row in late}]
+        if pled is not None:
+            # prune one height BEHIND: the just-finalized height's
+            # routes must survive for the straggler join
+            pled.prune_votes(height - 1)
         absent_idx: List[int] = []
         if commit_sigs is not None:
             bits = bytearray((len(commit_sigs) + 7) // 8)
@@ -274,20 +402,22 @@ class HeightLedger:
     def _fold_chronic(self, late: List[list],
                       absent_idx: List[int]) -> None:
         table = self._late_heights
-        for vidx, _off in late:
+        for vidx, _off, net_ms, sign_ms, _via in late:
             slot = table.get(vidx)
-            if slot is not None:
-                slot[0] += 1
-            elif len(table) < MAX_TRACKED_SIGNERS:
-                table[vidx] = [1, 0]
-            else:
-                self._late_dropped += 1
+            if slot is None:
+                if len(table) >= MAX_TRACKED_SIGNERS:
+                    self._late_dropped += 1
+                    continue
+                slot = table[vidx] = [0, 0, 0.0, 0.0]
+            slot[0] += 1
+            slot[2] = round(slot[2] + net_ms, 3)
+            slot[3] = round(slot[3] + sign_ms, 3)
         for vidx in absent_idx:
             slot = table.get(vidx)
             if slot is not None:
                 slot[1] += 1
             elif len(table) < MAX_TRACKED_SIGNERS:
-                table[vidx] = [0, 1]
+                table[vidx] = [0, 1, 0.0, 0.0]
             else:
                 self._late_dropped += 1
 
@@ -320,11 +450,14 @@ class HeightLedger:
 
     def top_late_signers(self, k: int = TOP_K_LATE) -> List[dict]:
         """The chronically-late table: validators ranked by how many
-        heights they arrived late or absent (the DCN round's
-        slow-host-vs-slow-curve column)."""
+        heights they arrived late or absent, with the cumulative
+        net-vs-sign split (the DCN round's slow-host-vs-slow-curve
+        column: a big net_ms says the HOP is slow, a big sign_ms says
+        the SIGNER is)."""
         rows = [{"val": vidx, "late_heights": late, "absent_heights": ab,
-                 "total": late + ab}
-                for vidx, (late, ab) in list(self._late_heights.items())]
+                 "net_ms": net, "sign_ms": sign, "total": late + ab}
+                for vidx, (late, ab, net, sign)
+                in list(self._late_heights.items())]
         rows.sort(key=lambda r: (-r["total"], r["val"]))
         return rows[:k]
 
@@ -365,6 +498,12 @@ class HeightLedger:
             "wal_fsync_ms": round(sum(r[_H_FSYNC] for r in recs), 3),
             "cold_table_heights": sum(1 for r in recs if r[_H_COLD]),
             "late_votes": int(sum(len(r[_H_LATE]) for r in recs)),
+            # the network-vs-crypto decomposition over every late
+            # arrival in the window: where the late milliseconds went
+            "late_net_ms": round(sum(
+                row[2] for r in recs for row in r[_H_LATE]), 3),
+            "late_sign_ms": round(sum(
+                row[3] for r in recs for row in r[_H_LATE]), 3),
             "absent_votes": int(sum(r[_H_ABSENT] for r in recs)),
             "catchup_heights": sum(
                 1 for r in recs if r[_H_VIA] == VIA_CATCHUP),

@@ -28,6 +28,7 @@ import time
 from typing import List, Optional
 
 from cometbft_tpu.consensus.state import ConsensusState, ProposalMsg
+from cometbft_tpu.p2p import peerledger as plmod
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.p2p.switch import Peer, Reactor
 from cometbft_tpu.types import part_set as psmod
@@ -320,6 +321,13 @@ class ConsensusReactor(Reactor):
                         ok = peer.send(VOTE_CHANNEL, _vote_bytes(vote))
                         self.votes_sent += 1
                         if ok is not False:
+                            # relay stamp: first-seen -> first-relay is
+                            # OUR forwarding latency for this vote (the
+                            # hop cost /dump_peers attributes)
+                            if self.switch is not None:
+                                self.switch.peer_ledger \
+                                    .note_vote_relayed(
+                                        (h, r, vtype, idx))
                             with self._lock:
                                 ps.mark_vote(r, vtype, idx, n)
                         budget -= 1
@@ -453,11 +461,24 @@ class ConsensusReactor(Reactor):
         n = len(cs.state.validators)
         key = (vote.height, vote.round, vote.vote_type,
                vote.validator_address, vote.signature)
+        # gossip observatory: first-seen stamp + delivering peer for
+        # the height ledger's net/sign late-signer join; duplicate
+        # receipts counted per vote AND per delivering peer
+        led = self.switch.peer_ledger if self.switch else None
+        vkey = (vote.height, vote.round, vote.vote_type,
+                vote.validator_index)
+        rec = getattr(peer, "ledger_rec", None)
         self.votes_received += 1
         if key in self._seen_votes:
             # duplicate delivery: mark the sender as holding it (it
-            # clearly does) — no relay, no re-verify
+            # clearly does) — no relay, no re-verify. The key includes
+            # the signature, so a dup here is a redelivery of already-
+            # VERIFIED bytes: safe to count into the route table.
             self.votes_duplicate += 1
+            if led is not None:
+                led.note_vote_seen(vkey, peer.peer_id[:12])
+            if rec is not None:
+                plmod.note_dup_vote(rec)
             with self._lock:
                 ps = self._peer_states.setdefault(peer, PeerState())
                 if vote.height == cs.height:
@@ -469,6 +490,24 @@ class ConsensusReactor(Reactor):
             # set nor useful to the state machine; catch-up channels (the
             # commit push above / blocksync) cover lagging nodes. Not a
             # punishable offence — honest peers race height transitions.
+            # No route stamping for arbitrary heights: attacker-chosen
+            # far-future keys would fill the bounded vote-route table
+            # with entries prune_votes never reaches (review finding).
+            from cometbft_tpu.types import canonical
+
+            if vote.height == cs.height - 1 \
+                    and vote.vote_type == canonical.PRECOMMIT_TYPE \
+                    and 0 <= vote.round <= cs.round + MAX_ROUND_AHEAD:
+                # straggler for the JUST-finalized height: stamp the
+                # route (bounded: one height back, sane rounds — the
+                # entry prunes at the next finalize) and forward for
+                # late-signer attribution — the consensus prefilter
+                # verifies it against last_validators, stamps the
+                # height ledger's net/sign late row, and drops it
+                # pre-WAL (ConsensusState._note_straggler)
+                if led is not None:
+                    led.note_vote_seen(vkey, peer.peer_id[:12])
+                cs.receive_vote(vote)
             return
         # synchronous verification BEFORE enqueue: a forged vote must
         # cost the sender its connection and go no further
@@ -485,6 +524,13 @@ class ConsensusReactor(Reactor):
             vote.verify(cs.state.chain_id, val.pub_key)  # raises on forgery
         except Exception as e:
             raise _PeerMisbehavior(f"invalid vote signature: {e}") from e
+        # route stamping AFTER the verify: a forged vote racing the
+        # honest gossip must not poison the first-seen hop attribution
+        # (its sender is disconnected above; review finding)
+        if led is not None:
+            led.note_vote_seen(vkey, peer.peer_id[:12])
+        if rec is not None:
+            plmod.note_vote_rx(rec)
         self._seen_votes.add(key)
         if len(self._seen_votes) > 50000:
             self._seen_votes.clear()

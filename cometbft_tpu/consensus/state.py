@@ -348,6 +348,7 @@ class ConsensusState(BaseService):
     def _handle(self, item, write_wal: bool) -> None:
         kind = item[0]
         if kind == "vote" and not self._vote_prefilter(item[1].vote):
+            self._note_straggler(item[1].vote)
             self._count_prefilter_drop(item[1].vote)
             # overload shield: a vote that fails the CHEAP stateless +
             # valset checks (unknown index, address mismatch, wrong
@@ -796,6 +797,53 @@ class ConsensusState(BaseService):
         except Exception:  # noqa: BLE001 - racing state: let it through
             return True
 
+    def _note_straggler(self, vote: Vote) -> None:
+        """Late-signer attribution for precommits that lost the height
+        race: the reference folds height-1 precommits into the next
+        LastCommit; this implementation drops them — which made the
+        height ledger's `late` rows structurally near-empty (finalize
+        is atomic with quorum, so with the block in hand nothing can
+        arrive 'after quorum' at the same height). The straggler path
+        closes that: a precommit for the JUST-finalized height and its
+        commit round is signature-verified against last_validators
+        (cost-bounded: wants_straggler gates to at most one verify per
+        validator per height, MAX_STRAGGLERS total — forged floods
+        stay cheap to shed) and folded into the finalized record with
+        the same net/sign split and hop join."""
+        try:
+            if (vote.vote_type != canonical.PRECOMMIT_TYPE
+                    or vote.height != self.height - 1
+                    or not vote.signature
+                    or vote.validator_index < 0):
+                return
+            led = self.height_ledger
+            if not led.wants_straggler(vote.height, vote.round,
+                                       vote.validator_index):
+                return
+            lv = self.state.last_validators
+            val = lv.get_by_index(vote.validator_index) \
+                if lv is not None else None
+            if val is None or val.address != vote.validator_address:
+                return
+            try:
+                vote.verify(self.state.chain_id, val.pub_key)
+            except Exception:  # noqa: BLE001 - forged straggler
+                # burn the slot: the per-validator-per-height one-
+                # verify bound must hold for INVALID signatures too,
+                # or a forged flood buys unbounded verifies on the
+                # consensus thread (review finding)
+                led.burn_straggler(vote.height, vote.round,
+                                   vote.validator_index)
+                return
+            net_ns = 0
+            if not vote.timestamp.is_zero():
+                net_ns = Timestamp.now().to_ns() \
+                    - vote.timestamp.to_ns()
+            led.note_straggler(vote.height, vote.round,
+                               vote.validator_index, net_ns)
+        except Exception:  # noqa: BLE001 - attribution must never
+            pass           # stall the receive routine
+
     def _count_prefilter_drop(self, vote: Vote) -> None:
         self.prefilter_drops += 1
         if self.metrics is not None:
@@ -869,9 +917,19 @@ class ConsensusState(BaseService):
                 # late-signer attribution: the validator's FIRST
                 # precommit arrival of each round, stamped BEFORE the
                 # quorum transitions below so the quorum-crossing vote
-                # itself never reads as late
+                # itself never reads as late. net_ns = receive instant
+                # minus the vote's own signing timestamp, both on
+                # Timestamp.now()'s clock (virtual under simnet, wall
+                # time live) — the in-flight half of the net_ms vs
+                # sign_ms late-signer split; clock skew between
+                # validators clamps at the ledger
+                net_ns = 0
+                if not vote.timestamp.is_zero():
+                    net_ns = Timestamp.now().to_ns() \
+                        - vote.timestamp.to_ns()
                 self.height_ledger.note_vote(vote.round,
-                                             vote.validator_index)
+                                             vote.validator_index,
+                                             net_ns)
             if self.on_vote_added is not None:
                 try:
                     # reactor hook: broadcast HasVote so peers stop

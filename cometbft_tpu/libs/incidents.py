@@ -27,6 +27,11 @@ instants and the snapshots replay byte-identically):
   * ``shed_storm``    — >= ``shed_storm`` sheddable-lane sheds inside
     ``window_s`` (the overload machinery is the only thing keeping the
     node alive — an operator should know NOW, not at the next scrape).
+  * ``peer_starvation`` — >= ``peer_starvation`` p2p send-queue stalls
+    (blocked puts + full-queue drops, counted by the peer ledger)
+    inside ``window_s``: gossip is backing up, so votes are about to
+    arrive late everywhere — the snapshot freezes the peer-ledger tail
+    naming WHICH peers' queues are starving.
   * ``forced``        — the ``incidents.force`` failpoint fired (tests
     and drills; arm ``incidents.force=raise*1``).
 
@@ -51,7 +56,7 @@ fp.register("incidents.force",
 INCIDENT_CAPACITY = 32
 
 TRIGGERS = ("commit_stall", "round_escalation", "breaker_flap",
-            "shed_storm", "forced")
+            "shed_storm", "peer_starvation", "forced")
 
 
 class IncidentRecorder:
@@ -61,13 +66,15 @@ class IncidentRecorder:
 
     def __init__(self, commit_stall_s: float = 20.0,
                  round_limit: int = 4, breaker_flaps: int = 4,
-                 shed_storm: int = 256, window_s: float = 10.0,
+                 shed_storm: int = 256, peer_starvation: int = 64,
+                 window_s: float = 10.0,
                  cooldown_s: float = 30.0,
                  capacity: int = INCIDENT_CAPACITY):
         self.commit_stall_s = float(commit_stall_s)
         self.round_limit = int(round_limit)
         self.breaker_flaps = int(breaker_flaps)
         self.shed_storm = int(shed_storm)
+        self.peer_starvation = int(peer_starvation)
         self.window_s = float(window_s)
         self.cooldown_s = float(cooldown_s)
         self._ring: deque = deque(maxlen=max(4, int(capacity)))
@@ -82,6 +89,8 @@ class IncidentRecorder:
         self._brk_win = (0, -1)
         # shed-storm window: (window start ns, sheds since)
         self._shed_win = (0, 0)
+        # peer-starvation window: (window start ns, queue stalls since)
+        self._peer_win = (0, 0)
         self._fingerprint: Optional[dict] = None
         # real-clock watchdog ticker (production only): a quorumless
         # partition wedges the step machine with NO transitions — the
@@ -105,6 +114,7 @@ class IncidentRecorder:
                 "round_limit": self.round_limit,
                 "breaker_flaps": self.breaker_flaps,
                 "shed_storm": self.shed_storm,
+                "peer_starvation": self.peer_starvation,
                 "window_s": self.window_s,
                 "cooldown_s": self.cooldown_s}
 
@@ -126,6 +136,16 @@ class IncidentRecorder:
             start, count = self._shed_win
             self._shed_win = (start, count + n)
 
+    def note_peer_stall(self, n: int = 1) -> None:
+        """P2p send-queue stalls (blocked puts + full-queue drops,
+        counted by the peer ledger on its send seams) — accumulated
+        into the starvation window; the NEXT poke evaluates it. Same
+        lock discipline as the shed window: the MConnection send
+        threads race the poking threads' resets."""
+        with self._lock:
+            start, count = self._peer_win
+            self._peer_win = (start, count + n)
+
     def poke(self, height: int = 0, round_: int = 0) -> None:
         """Evaluate every trigger. Called on each consensus step
         transition — cheap when nothing is wrong: a clock read and a
@@ -140,6 +160,7 @@ class IncidentRecorder:
             with self._lock:
                 self._brk_win = (0, -1)
                 self._shed_win = (0, 0)
+                self._peer_win = (0, 0)
             return
         try:
             fp.fail_point("incidents.force")
@@ -159,6 +180,7 @@ class IncidentRecorder:
                  "limit_s": self.commit_stall_s})
         self._check_breaker(now, height, round_)
         self._check_sheds(now, height, round_)
+        self._check_peer_stalls(now, height, round_)
 
     def _check_breaker(self, now: int, height: int, round_: int) -> None:
         # read the device breaker only when its module already loaded —
@@ -209,6 +231,27 @@ class IncidentRecorder:
             self._shed_win = (now, 0)
         self._fire("shed_storm", now, height, round_,
                    {"sheds": count, "window_s": self.window_s})
+
+    def _check_peer_stalls(self, now: int, height: int,
+                           round_: int) -> None:
+        # the shed-storm window semantics verbatim: expiry checked
+        # BEFORE the threshold so a wedged poker waking late reports a
+        # drip as a drip, not a starvation burst
+        with self._lock:
+            start, count = self._peer_win
+            if not count:
+                return
+            if not start:
+                self._peer_win = (now, count)
+                return
+            if now - start > self.window_s * 1e9:
+                self._peer_win = (now, 0)
+                return
+            if count < self.peer_starvation:
+                return
+            self._peer_win = (now, 0)
+        self._fire("peer_starvation", now, height, round_,
+                   {"stalls": count, "window_s": self.window_s})
 
     # -- the real-clock watchdog ticker (node lifecycle) -------------------
 
@@ -279,6 +322,7 @@ class IncidentRecorder:
             "detail": detail,
             "flush_tail": [],
             "height_tail": [],
+            "peer_tail": [],
             "trace_tail": tracing.tail(24),
             "counters": self._counters(),
             "fingerprint": self._fingerprint,
@@ -293,6 +337,15 @@ class IncidentRecorder:
         if hl is not None:
             try:
                 snap["height_tail"] = hl.ledger_tail(8)
+            except Exception:  # noqa: BLE001
+                pass
+        pl = sys.modules.get("cometbft_tpu.p2p.peerledger")
+        if pl is not None:
+            try:
+                # the peer-ledger tail names WHICH peers' queues were
+                # starving / which links were eating messages at the
+                # instant the trigger fired
+                snap["peer_tail"] = pl.ledger_tail(8)
             except Exception:  # noqa: BLE001
                 pass
         return snap
@@ -326,6 +379,17 @@ class IncidentRecorder:
         led = hl and hl.global_ledger()
         if led is not None:
             out["heights_recorded"] = len(led)
+        pl = sys.modules.get("cometbft_tpu.p2p.peerledger")
+        pled = pl and pl.global_ledger()
+        if pled is not None:
+            try:
+                s = pled.summary()
+                out["peers"] = {"live": s["peers_live"],
+                                "blocked_puts": s["blocked_puts"],
+                                "full_drops": s["full_drops"],
+                                "link_drops": s["link_drops"]}
+            except Exception:  # noqa: BLE001
+                pass
         return out
 
     # -- readers -----------------------------------------------------------
@@ -405,6 +469,10 @@ def note_commit(height: int) -> None:
 
 def note_shed(n: int = 1) -> None:
     _RECORDER.note_shed(n)
+
+
+def note_peer_stall(n: int = 1) -> None:
+    _RECORDER.note_peer_stall(n)
 
 
 def dump_incidents() -> dict:

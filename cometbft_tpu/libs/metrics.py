@@ -396,6 +396,51 @@ class NodeMetrics:
             "code (admission fast-reject or BULK-lane shed)")
         # p2p
         self.peers = r.gauge("p2p", "peers", "Connected peers")
+        # gossip observatory (p2p/peerledger.py): the always-on
+        # per-peer traffic ledger sampled at scrape time from the
+        # registered ledger — totals here, the per-peer split in
+        # /dump_peers
+        self.p2p_peer_msgs = r.counter(
+            "p2p", "peer_msgs_total",
+            "Messages across all peers (dir=tx|rx), sampled from the "
+            "peer ledger at scrape time")
+        self.p2p_peer_bytes = r.counter(
+            "p2p", "peer_bytes_total",
+            "Wire bytes across all peers (dir=tx|rx)")
+        self.p2p_queue_full_drops = r.counter(
+            "p2p", "send_queue_full_drops_total",
+            "Messages dropped on a full per-channel send queue "
+            "(non-blocking sends and timed-out blocking sends both "
+            "count — the starvation signal the peer_starvation "
+            "incident trigger watches)")
+        self.p2p_blocked_puts = r.counter(
+            "p2p", "send_blocked_puts_total",
+            "Blocking sends that had to WAIT on a full send queue "
+            "(the backed-up-queue half of the late-signer net_ms)")
+        self.p2p_throttle_stalls = r.counter(
+            "p2p", "throttle_stalls_total",
+            "Send-routine stalls on the flow-control token bucket")
+        self.p2p_link_drops = r.counter(
+            "p2p", "link_drops_total",
+            "Messages eaten by the link itself (simnet partitions, "
+            "dead writes) — attributed per peer in /dump_peers")
+        self.p2p_injected_faults = r.counter(
+            "p2p", "injected_faults_total",
+            "Faults injected by the fuzzer / simnet fault model "
+            "(kind=drop|delay) — chaos runs attribute themselves "
+            "instead of blaming the network")
+        self.p2p_dup_votes = r.counter(
+            "p2p", "duplicate_votes_total",
+            "Duplicate vote-message receipts (lack-based gossip keeps "
+            "this near zero; growth means HasVote/VoteSetBits healing "
+            "is lagging)")
+        self.p2p_ping_rtt = r.gauge(
+            "p2p", "ping_rtt_ms",
+            "Last measured ping RTT per peer (label peer; bounded "
+            "top-K live peers)")
+        self.p2p_ledger_peers = r.gauge(
+            "p2p", "peer_ledger_peers",
+            "Live peers currently tracked by the peer ledger")
         # blocksync
         self.blocksync_syncing = r.gauge("blocksync", "syncing",
                                          "1 while block-syncing")
@@ -630,6 +675,39 @@ class NodeMetrics:
             for kind, n in rec.fired.items():
                 self.incidents_fired._set((("trigger", kind),),
                                           float(n))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            # gossip observatory (module-loaded-only like the plane:
+            # the ledger belongs to whichever switch registered last —
+            # same _LAST caveat as the flush percentiles)
+            pl = sys.modules.get("cometbft_tpu.p2p.peerledger")
+            led = pl and pl.global_ledger()
+            if led is not None:
+                s = led.summary()
+                self.p2p_ledger_peers.set(float(s["peers_live"]))
+                self.p2p_peer_msgs._set((("dir", "tx"),),
+                                        float(s["msgs_tx"]))
+                self.p2p_peer_msgs._set((("dir", "rx"),),
+                                        float(s["msgs_rx"]))
+                self.p2p_peer_bytes._set((("dir", "tx"),),
+                                         float(s["bytes_tx"]))
+                self.p2p_peer_bytes._set((("dir", "rx"),),
+                                         float(s["bytes_rx"]))
+                self.p2p_queue_full_drops._set(
+                    (), float(s["full_drops"]))
+                self.p2p_blocked_puts._set(
+                    (), float(s["blocked_puts"]))
+                self.p2p_throttle_stalls._set(
+                    (), float(s["throttle_stalls"]))
+                self.p2p_link_drops._set((), float(s["link_drops"]))
+                self.p2p_injected_faults._set(
+                    (("kind", "drop"),), float(s["inj_drops"]))
+                self.p2p_injected_faults._set(
+                    (("kind", "delay"),), float(s["inj_delays"]))
+                self.p2p_dup_votes._set((), float(s["dup_votes"]))
+                for peer, rtt in led.rtt_rows():
+                    self.p2p_ping_rtt.set(float(rtt), peer=peer)
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
 

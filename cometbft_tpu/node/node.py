@@ -277,6 +277,12 @@ class Node(BaseService):
                 os.path.join(home, "node_key.json") if home else None
             )
             self.switch = Switch(nk, state.chain_id)
+            # gossip observatory -> height ledger join: late-signer
+            # rows name the delivering hop, and net_ms/sign_ms split
+            # against THIS node's peer ledger (never the module global
+            # — multi-node processes each join their own)
+            self.consensus.height_ledger.peer_ledger = \
+                self.switch.peer_ledger
             self.consensus_reactor = ConsensusReactor(self.consensus)
             self.switch.add_reactor(self.consensus_reactor)
             self.mempool_reactor = MempoolReactor(self.mempool)

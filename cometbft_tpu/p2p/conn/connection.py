@@ -14,16 +14,25 @@ Flow control is a token bucket on bytes/sec applied in the send routine
 (the libs/flowrate analog)."""
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from cometbft_tpu.p2p import peerledger
+
+_log = logging.getLogger(__name__)
+
 MAX_PACKET_PAYLOAD = 1400     # connection.go maxPacketMsgPayloadSize
 PING_INTERVAL = 10.0
 SEND_RATE = 5_120_000         # config default send_rate bytes/s
 RECV_RATE = 5_120_000
+SEND_TIMEOUT = 10.0           # blocking Send's queue.Full deadline
+# rate limit for full-queue warnings: a starved peer must be VISIBLE in
+# the log without a 2000-deep queue turning it into a log flood
+_FULL_LOG_INTERVAL = 1.0
 
 
 @dataclass
@@ -58,6 +67,7 @@ class MConnection:
         on_receive: Callable[[int, bytes], None],
         on_error: Optional[Callable[[Exception], None]] = None,
         send_rate: int = SEND_RATE,
+        ledger_rec: Optional[list] = None,
     ):
         self.conn = conn
         self.channels: Dict[int, _Channel] = {
@@ -66,6 +76,12 @@ class MConnection:
         self.on_receive = on_receive
         self.on_error = on_error or (lambda e: None)
         self.send_rate = send_rate
+        # the gossip-observatory seam (p2p/peerledger.py): the Switch
+        # hands in the per-peer record; bare MConnections get a
+        # detached one so the instrumentation path is unconditional
+        self._led = ledger_rec if ledger_rec is not None \
+            else peerledger.detached_record()
+        self._last_full_log = 0.0
         self._send_wake = threading.Event()
         self._stop = threading.Event()
         self._err_once = threading.Lock()
@@ -91,24 +107,56 @@ class MConnection:
     # -- sending -----------------------------------------------------------
 
     def send(self, chan_id: int, msg: bytes, block: bool = True) -> bool:
-        """Queue msg on the channel (Send/TrySend, connection.go:268)."""
+        """Queue msg on the channel (Send/TrySend, connection.go:268).
+
+        A False from a FULL queue was previously indistinguishable from
+        a stopped conn: now every full-queue outcome increments the
+        peer ledger's counters (blocked_puts for a blocking send that
+        had to wait, full_drops for a drop) and logs rate-limited — a
+        starving peer is visible in /dump_peers and the log, not just
+        as silently missing gossip."""
         ch = self.channels.get(chan_id)
         if ch is None or self._stop.is_set():
             return False
         try:
-            ch.send_queue.put(msg, block=block, timeout=10 if block else None)
+            ch.send_queue.put_nowait(msg)
         except queue.Full:
-            return False
+            if not block:
+                peerledger.note_full_drop(self._led)
+                self._log_full(chan_id)
+                return False
+            # blocking path: the queue is full RIGHT NOW — count the
+            # stall before waiting out the timeout
+            peerledger.note_blocked_put(self._led)
+            try:
+                ch.send_queue.put(msg, timeout=SEND_TIMEOUT)
+            except queue.Full:
+                peerledger.note_full_drop(self._led)
+                self._log_full(chan_id, timed_out=True)
+                return False
+        peerledger.note_queue_depth(self._led, ch.send_queue.qsize())
         self._send_wake.set()
         return True
 
+    def _log_full(self, chan_id: int, timed_out: bool = False) -> None:
+        now = time.monotonic()
+        if now - self._last_full_log < _FULL_LOG_INTERVAL:
+            return
+        self._last_full_log = now
+        _log.warning(
+            "peer %s send queue full on %#x (%s; %d drops so far)",
+            self._led[0], chan_id,
+            "blocking send timed out" if timed_out else "dropped",
+            self._led[peerledger._P_FULLDROP])
+
     def _pick_channel(self) -> Optional[_Channel]:
         """Least (recently_sent / priority) among channels with queued
-        data (connection.go sendPacketMsg's least-ratio rule)."""
+        data (connection.go sendPacketMsg's least-ratio rule). A dead
+        ``and not ch.recv_buf: pass`` branch used to sit here — recv_buf
+        is the RECEIVE reassembly buffer and has no bearing on send
+        eligibility."""
         best, best_ratio = None, None
         for ch in self.channels.values():
-            if ch.send_queue.empty() and not ch.recv_buf:
-                pass
             if ch.send_queue.empty():
                 continue
             ratio = ch.recently_sent / max(1, ch.desc.priority)
@@ -128,6 +176,9 @@ class MConnection:
                 )
                 last = now
                 if now - last_ping > PING_INTERVAL:
+                    # stamp BEFORE the write so the measured RTT covers
+                    # the wire round trip, not just our recv latency
+                    peerledger.note_ping_sent(self._led)
                     self.conn.write_msg(b"P")
                     last_ping = now
                 ch = self._pick_channel()
@@ -136,11 +187,16 @@ class MConnection:
                     self._send_wake.clear()
                     continue
                 if budget <= 0:
+                    # flow-control throttle: the token bucket is dry
+                    peerledger.note_throttle(self._led, 5.0)
                     time.sleep(0.005)
                     continue
                 msg = ch.send_queue.get_nowait()
+                peerledger.note_queue_depth(self._led,
+                                            ch.send_queue.qsize())
                 # split into packets with EOF marker
                 off = 0
+                wire_bytes = 0
                 while True:
                     part = msg[off:off + MAX_PACKET_PAYLOAD]
                     off += len(part)
@@ -148,9 +204,12 @@ class MConnection:
                     pkt = b"M" + bytes([ch.desc.chan_id]) + eof + part
                     self.conn.write_msg(pkt)
                     ch.recently_sent += len(pkt)
+                    wire_bytes += len(pkt)
                     budget -= len(pkt)
                     if eof == b"\x01":
                         break
+                peerledger.note_sent(self._led, ch.desc.chan_id,
+                                     wire_bytes)
                 # decay so quiet channels regain priority
                 for c in self.channels.values():
                     c.recently_sent = int(c.recently_sent * 0.8)
@@ -170,7 +229,10 @@ class MConnection:
                 if kind == b"P":
                     self.conn.write_msg(b"O")
                 elif kind == b"O":
-                    pass  # pong: keepalive refresh happened above
+                    # pong: stamp the RTT against the matching ping
+                    # (previously nothing measured it — the ledger's
+                    # per-peer rtt_ms column is this)
+                    peerledger.note_pong(self._led)
                 elif kind == b"M":
                     chan_id, eof = pkt[1], pkt[2]
                     ch = self.channels.get(chan_id)
@@ -179,6 +241,8 @@ class MConnection:
                     ch.recv_buf += pkt[3:]
                     if len(ch.recv_buf) > ch.desc.recv_message_capacity:
                         raise ValueError("recv message exceeds capacity")
+                    peerledger.note_recv(self._led, chan_id, len(pkt),
+                                         eof=eof == 1)
                     if eof == 1:
                         msg, ch.recv_buf = ch.recv_buf, b""
                         self.on_receive(chan_id, msg)

@@ -13,6 +13,8 @@ import random
 import time
 from dataclasses import dataclass
 
+from cometbft_tpu.p2p import peerledger
+
 
 @dataclass
 class FuzzConnConfig:
@@ -28,11 +30,20 @@ class FuzzConnConfig:
 
 class FuzzedSocket:
     """Socket-like wrapper injecting drops/stalls/closes on writes and
-    reads. Deterministic for a given (seed, op sequence)."""
+    reads. Deterministic for a given (seed, op sequence).
 
-    def __init__(self, sock, config: FuzzConnConfig):
+    ``ledger_rec`` (a p2p/peerledger.py record) attributes every
+    injected fault to the fuzzer instead of the network: a chaos run's
+    /dump_peers shows ``inj_drops``/``inj_delays`` on the fuzzed peer,
+    so the operator reading the ledger knows the packet loss was
+    scheduled, not organic."""
+
+    def __init__(self, sock, config: FuzzConnConfig,
+                 ledger_rec=None):
         self._sock = sock
         self.config = config
+        self._rec = ledger_rec if ledger_rec is not None \
+            else peerledger.detached_record("fuzz")
         self._rng = random.Random(config.seed)
         self._born = time.monotonic()
         self._dead = False
@@ -49,11 +60,16 @@ class FuzzedSocket:
             return False
         c, r = self.config, self._rng
         if c.prob_drop_conn and r.random() < c.prob_drop_conn:
+            peerledger.note_inj_drop(self._rec)
             self.close()
             raise OSError("fuzz: connection dropped")
         if c.prob_sleep and r.random() < c.prob_sleep:
+            peerledger.note_inj_delay(self._rec)
             time.sleep(r.uniform(0, c.max_sleep_s))
-        return bool(c.prob_drop_rw and r.random() < c.prob_drop_rw)
+        if c.prob_drop_rw and r.random() < c.prob_drop_rw:
+            peerledger.note_inj_drop(self._rec)
+            return True
+        return False
 
     # -- socket surface ----------------------------------------------------
 

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.p2p import peerledger
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnection
 from cometbft_tpu.p2p.key import NetAddress, NodeInfo, NodeKey
 from cometbft_tpu.p2p.transport import Transport, UpgradedConn
@@ -57,10 +58,15 @@ class Peer:
         self.peer_id = up.node_info.node_id
         self.outbound = up.outbound
         self.remote_addr = up.remote_addr
+        # gossip observatory: one ledger record per peer, shared with
+        # the MConnection's send/recv routines (p2p/peerledger.py)
+        self.ledger_rec = sw.peer_ledger.open_peer(
+            self.peer_id[:12], up.outbound)
         self.mconn = MConnection(
             up.sconn, channels,
             on_receive=self._on_receive,
             on_error=self._on_error,
+            ledger_rec=self.ledger_rec,
         )
         self._data: Dict[str, object] = {}  # reactor scratch (PeerState)
 
@@ -105,6 +111,9 @@ class Switch(BaseService):
         self.transport = Transport(node_key, self.node_info, self._on_conn)
         self.listen_addr: Optional[NetAddress] = None
         self._redial_thread: Optional[threading.Thread] = None
+        # gossip observatory (/dump_peers): always on, like the flush
+        # and height ledgers
+        self.peer_ledger = peerledger.PeerLedger()
 
     # -- wiring ------------------------------------------------------------
 
@@ -124,6 +133,7 @@ class Switch(BaseService):
         return self.listen_addr
 
     def on_start(self) -> None:
+        peerledger.set_global_ledger(self.peer_ledger)
         self._redial_thread = threading.Thread(
             target=self._redial_loop, daemon=True, name="p2p-redial"
         )
@@ -135,15 +145,32 @@ class Switch(BaseService):
             peers = list(self.peers.values())
         for p in peers:
             p.stop()
+            self.peer_ledger.drop_peer(p.ledger_rec, "switch_stop")
+        # keep serving history via the module _LAST fallback
+        peerledger.clear_global_ledger(self.peer_ledger)
 
     # -- peer lifecycle ----------------------------------------------------
 
     def _on_conn(self, up: UpgradedConn) -> None:
+        pid = up.node_info.node_id
+        with self._peers_lock:
+            dup = pid in self.peers or pid == self.node_key.node_id
+        if dup:
+            # reject BEFORE Peer() opens a ledger record: open_peer's
+            # replace semantics would otherwise retire the SURVIVING
+            # connection's live record
+            try:
+                up.sconn._stream.close()
+            except Exception:  # noqa: BLE001 - already closing
+                pass
+            self.peer_ledger.lifecycle(peerledger.EV_DROP, pid[:12],
+                                       "duplicate")
+            return
         peer = Peer(self, up, self.channel_descs)
         with self._peers_lock:
-            if peer.peer_id in self.peers or \
-                    peer.peer_id == self.node_key.node_id:
+            if peer.peer_id in self.peers:
                 peer.mconn.conn._stream.close()
+                self.peer_ledger.drop_peer(peer.ledger_rec, "duplicate")
                 return
             self.peers[peer.peer_id] = peer
         peer.start()
@@ -158,10 +185,14 @@ class Switch(BaseService):
         with self._peers_lock:
             if addr.node_id in self.peers:
                 return
+        self.peer_ledger.lifecycle(peerledger.EV_DIAL,
+                                   addr.node_id[:12], str(addr))
         try:
             fp.fail_point("p2p.dial")
             self.transport.dial(addr)
         except Exception as e:  # noqa: BLE001
+            self.peer_ledger.lifecycle(peerledger.EV_DIAL_FAIL,
+                                       addr.node_id[:12], str(e)[:80])
             _log.warning("dial %s failed: %s", addr, e)
 
     def dial_peers_async(self, addrs: List[NetAddress],
@@ -179,6 +210,7 @@ class Switch(BaseService):
                 return
             del self.peers[peer.peer_id]
         peer.stop()
+        self.peer_ledger.drop_peer(peer.ledger_rec, reason)
         for r in self.reactors.values():
             r.remove_peer(peer, reason)
         _log.info("peer %s stopped: %s", peer.peer_id[:12], reason)

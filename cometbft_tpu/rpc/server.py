@@ -598,11 +598,28 @@ class Routes:
     def dump_incidents(self):
         """The incident flight recorder's frozen snapshots (also GET
         /dump_incidents): what tripped the watchdog (commit stall,
-        round escalation, breaker flap, shed storm), with the height/
-        flush/trace tails and counter sample frozen AT trigger time."""
+        round escalation, breaker flap, shed storm, peer starvation),
+        with the height/flush/peer/trace tails and counter sample
+        frozen AT trigger time."""
         from cometbft_tpu.libs import incidents
 
         return incidents.dump_incidents()
+
+    def dump_peers(self):
+        """The gossip observatory (p2p/peerledger.py): per-peer traffic
+        ledger — msgs/bytes per channel, send-queue depth/high-water,
+        blocked puts, full-queue drops, throttle stalls, ping RTT,
+        injected-fault attribution, lifecycle events, and the vote
+        first-seen/relay counters (also served as GET /dump_peers).
+        Always on like the flush and height ledgers; the module _LAST
+        fallback serves post-mortem reads after the switch stopped."""
+        from cometbft_tpu.p2p import peerledger
+
+        sw = getattr(self.node, "switch", None)
+        led = getattr(sw, "peer_ledger", None)
+        if led is not None:
+            return led.dump()
+        return peerledger.dump_peers()
 
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
@@ -693,7 +710,7 @@ _ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes", "dump_heights",
-    "dump_incidents",
+    "dump_incidents", "dump_peers",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -813,7 +830,8 @@ class _Handler(BaseHTTPRequestHandler):
         # surface next to /metrics): traces (perfetto-loadable),
         # the always-on flush/height ledgers, incident snapshots
         if url.path in ("/dump_traces", "/dump_flushes",
-                        "/dump_heights", "/dump_incidents"):
+                        "/dump_heights", "/dump_incidents",
+                        "/dump_peers"):
             self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):

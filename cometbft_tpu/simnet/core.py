@@ -67,6 +67,7 @@ from cometbft_tpu.consensus.ticker import TimeoutInfo
 from cometbft_tpu.evidence.reactor import EVIDENCE_CHANNEL
 from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs import tracing
+from cometbft_tpu.p2p import peerledger
 from cometbft_tpu.types import serde
 from cometbft_tpu.types.evidence import (
     EvidenceError,
@@ -101,20 +102,30 @@ class SimConn:
     """One direction of an established sim connection — the MConnection
     seam (`send(chan_id, msg) -> bool`, `on_receive(chan_id, msg)`).
     Channel IDs are the real reactors'; the fault model applies per
-    send."""
+    send. Carries the src node's peer-ledger record for the dst peer —
+    the SAME p2p/peerledger.py seam the real MConnection writes, so a
+    scheduled partition's drops are attributed per peer and the ledger
+    replays byte-identically (stamps ride the virtual clock)."""
 
-    def __init__(self, net: "SimNetwork", src: int, dst: int):
+    def __init__(self, net: "SimNetwork", src: int, dst: int,
+                 outbound: bool = True):
         self.net = net
         self.src = src
         self.dst = dst
         self.closed = False
+        self.rec = net.nodes[src].peer_ledger.open_peer(
+            f"n{dst}", outbound=outbound)
 
     def send(self, chan_id: int, msg: bytes, block: bool = True) -> bool:
         if self.closed:
             return False
-        return self.net._send(self.src, self.dst, chan_id, msg)
+        return self.net._send(self.src, self.dst, chan_id, msg,
+                              rec=self.rec)
 
     def close(self) -> None:
+        if not self.closed:
+            self.net.nodes[self.src].peer_ledger.drop_peer(
+                self.rec, "closed")
         self.closed = True
 
 
@@ -141,7 +152,7 @@ class SimTransport:
         if not peer.transport.listening:
             raise ConnectionError(f"sim node {peer_idx} not listening")
         ours = SimConn(self.net, self.idx, peer_idx)
-        theirs = SimConn(self.net, peer_idx, self.idx)
+        theirs = SimConn(self.net, peer_idx, self.idx, outbound=False)
         self.on_conn(ours)
         peer.transport.on_conn(theirs)
         return ours
@@ -211,6 +222,10 @@ class SimNode:
         self.registry = fp.fresh_registry(fp.simulated_crash)
         self.transport = SimTransport(net, idx, self._on_conn)
         self.conns: Dict[int, SimConn] = {}  # peer idx -> outbound conn
+        # gossip observatory: one per node, surviving restarts — the
+        # same always-on ledger a real Switch carries, on the virtual
+        # clock (byte-identical across replays)
+        self.peer_ledger = peerledger.PeerLedger()
         self.node = None
         self.alive = False
         self.crashed = False
@@ -248,6 +263,10 @@ class SimNode:
             from cometbft_tpu.consensus import heightledger
 
             heightledger.set_global_ledger(cs.height_ledger)
+            # the net/sign late-signer join + last-started-wins global
+            # registration (incident snapshots, replay-blob tails)
+            cs.height_ledger.peer_ledger = self.peer_ledger
+            peerledger.set_global_ledger(self.peer_ledger)
             # mark the service running without spawning its thread: the
             # scheduler pumps the queues the thread would have drained
             with cs._lock:
@@ -617,19 +636,30 @@ class SimNetwork:
     # -- transport ---------------------------------------------------------
 
     def _send(self, src: int, dst: int, chan_id: int,
-              payload: bytes) -> bool:
+              payload: bytes, rec=None) -> bool:
         link = self.links[(src, dst)]
         if not link.up:
+            # the partition is VISIBLE in the ledger: the lost message
+            # is attributed to the partitioned peer, which is what the
+            # chaos-soak acceptance asserts
+            if rec is not None:
+                peerledger.note_link_drop(rec)
             return False
         r = self.rng
         if link.drop > 0.0 and r.random() < link.drop:
+            if rec is not None:
+                peerledger.note_inj_drop(rec)
             return True  # accepted for delivery, silently lost
+        if rec is not None:
+            peerledger.note_sent(rec, chan_id, len(payload))
         delay = link.delay
         if link.jitter > 0.0:
             delay += link.jitter * r.random()
         if link.reorder > 0.0 and r.random() < link.reorder:
             # push far enough back that later sends overtake this one
             delay += link.reorder_window * (0.5 + r.random())
+            if rec is not None:
+                peerledger.note_inj_delay(rec)
         self.schedule(delay,
                       lambda: self._deliver(dst, chan_id, payload, src),
                       f"deliver {src}->{dst}")
@@ -645,6 +675,10 @@ class SimNetwork:
         node = self.nodes[dst]
         if not node.alive:
             return
+        if src is not None:
+            rec = node.peer_ledger.rec_for(f"n{src}")
+            if rec is not None:
+                peerledger.note_recv(rec, chan_id, len(payload))
         crash = None
         with self._node_scope(node):
             try:
@@ -667,7 +701,18 @@ class SimNetwork:
         j = json.loads(payload.decode())
         if chan_id == VOTE_CHANNEL:
             # the reactor's bare vote_to_j wire form
-            cs.receive_vote(serde.vote_from_j(j))
+            vote = serde.vote_from_j(j)
+            # vote-propagation attribution: first-seen stamp + the
+            # delivering hop (duplicate deliveries — link.dup faults,
+            # retransmissions — count as dup receipts), same seam as
+            # ConsensusReactor._receive_vote; gated to the two heights
+            # the ledger ever joins so junk keys can't pin the table
+            if cs.height - 1 <= vote.height <= cs.height:
+                node.peer_ledger.note_vote_seen(
+                    (vote.height, vote.round, vote.vote_type,
+                     vote.validator_index),
+                    f"n{src}" if src is not None else "?")
+            cs.receive_vote(vote)
         elif chan_id == DATA_CHANNEL:
             if j.get("t") == "commit_block":
                 cs.receive_commit_block(

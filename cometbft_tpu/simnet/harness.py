@@ -31,7 +31,8 @@ class SimnetFailure(AssertionError):
     def __init__(self, msg: str, seed: int, schedule: List[Dict],
                  include_ledger: bool = True,
                  include_heights: bool = True,
-                 include_incidents: bool = True):
+                 include_incidents: bool = True,
+                 include_peers: bool = True):
         self.seed = seed
         self.schedule = schedule
         text = msg
@@ -62,6 +63,14 @@ class SimnetFailure(AssertionError):
         h_tail = heightledger.ledger_tail(8) if include_heights else []
         if h_tail:
             text += "\nheight ledger tail: " + " | ".join(h_tail)
+        # the gossip observatory's per-peer tail: which links were
+        # eating/queueing messages when the run failed (same move-mark
+        # gating as the other always-on ledgers)
+        from cometbft_tpu.p2p import peerledger
+
+        p_tail = peerledger.ledger_tail(8) if include_peers else []
+        if p_tail:
+            text += "\npeer ledger tail: " + " | ".join(p_tail)
         # incidents frozen DURING this simulation (commit stalls, round
         # escalations, ...) are first-class replay evidence
         inc_tail = incidents.incident_tail(4) if include_incidents \
@@ -99,10 +108,12 @@ class Simnet:
         from cometbft_tpu import verifyplane
         from cometbft_tpu.consensus import heightledger
         from cometbft_tpu.libs import incidents
+        from cometbft_tpu.p2p import peerledger
 
         self._ledger_mark = verifyplane.ledger_mark()
         self._height_mark = heightledger.ledger_mark()
         self._incident_mark = incidents.incident_mark()
+        self._peer_mark = peerledger.ledger_mark()
 
     # -- running -----------------------------------------------------------
 
@@ -406,6 +417,7 @@ class Simnet:
         from cometbft_tpu import verifyplane
         from cometbft_tpu.consensus import heightledger
         from cometbft_tpu.libs import incidents
+        from cometbft_tpu.p2p import peerledger
 
         return SimnetFailure(
             msg, self.net.seed, self.schedule,
@@ -414,6 +426,7 @@ class Simnet:
                 self._height_mark),
             include_incidents=incidents.incident_advanced(
                 self._incident_mark),
+            include_peers=peerledger.ledger_advanced(self._peer_mark),
         )
 
     def commit_hashes(self) -> List[Dict[int, bytes]]:

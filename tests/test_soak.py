@@ -342,21 +342,24 @@ def _run_incident_soak(basedir, seed: int = 3131):
                 "chain never recovered after the quorumless partition"
             sim.assert_safety()
             hashes = sim.commit_hashes()
+            peer_dumps = [n.peer_ledger.dump() for n in sim.net.nodes]
     finally:
         incidents.install(old)
         set_global_plane(None)
         plane.stop()
         fp.reset()
-    return hashes, rec.dump()
+    return hashes, rec.dump(), peer_dumps
 
 
 def test_chaos_soak_commit_stall_incident_replays(tmp_path):
     """The acceptance scenario: the partition-induced stall fires a
-    commit_stall incident with the height/flush tails frozen AT the
-    stall, and the same (seed, schedule) yields a byte-identical
-    incident stream AND chain."""
-    h1, d1 = _run_incident_soak(tmp_path / "a")
-    h2, d2 = _run_incident_soak(tmp_path / "b")
+    commit_stall incident with the height/flush/peer tails frozen AT
+    the stall, the gossip observatory attributes the partition's lost
+    messages to the partitioned peers, and the same (seed, schedule)
+    yields a byte-identical incident stream, chain, AND per-node peer
+    ledger (ISSUE 14 chaos-soak acceptance)."""
+    h1, d1, p1 = _run_incident_soak(tmp_path / "a")
+    h2, d2, p2 = _run_incident_soak(tmp_path / "b")
     assert h1 == h2
     assert d1["fired"].get("commit_stall", 0) >= 1, d1["fired"]
     assert json.dumps(d1, sort_keys=True) == \
@@ -367,7 +370,18 @@ def test_chaos_soak_commit_stall_incident_replays(tmp_path):
     # timelines and the plane's last flushes (the flood was riding it)
     assert snap["height_tail"], snap
     assert snap["flush_tail"], snap
+    # ... and the gossip observatory's per-peer tail (which links were
+    # eating messages when the stall hit)
+    assert snap["peer_tail"], snap
     assert snap["counters"]["plane"]["rows"] > 0
+    # peer ledgers replay byte-identically and the 2/2 partition is
+    # attributed: node 0's cross-group records ate link drops, its
+    # same-side record did not
+    assert json.dumps(p1, sort_keys=True) == \
+        json.dumps(p2, sort_keys=True)
+    n0 = {p["peer"]: p for p in p1[0]["peers"]}
+    assert n0["n2"]["link_drops"] + n0["n3"]["link_drops"] > 0, n0
+    assert n0["n1"]["link_drops"] == 0, n0
 
 
 def test_flood_reaches_blocks(tmp_path):

@@ -36,13 +36,14 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 7  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 8  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
                                "cfg12_smoke", "cfg13_smoke",
+                               "cfg14_smoke",
                                "cfg2_smoke", "cfg4_smoke",
                                "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
@@ -71,6 +72,11 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert ch["resident_bytes_peak"] <= 4 * 4096
     assert ch["warmer"]["builds_failed"] == 1
     assert ch["warmer"]["builds_ok"] == 1
+    # the cfg14 miniature proved the gossip-observatory bookkeeping
+    # cost (the per-message seam every MConnection/SimConn hop rides)
+    pd = results["cfg14_smoke"]["extra"]["peer_path"]
+    assert 0 < pd["send_us_per_msg"] < 10.0
+    assert 0 < pd["recv_us_per_msg"] < 10.0
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

@@ -138,6 +138,15 @@ def stage_report(dump: dict) -> dict:
         "catchup_heights": sum(
             1 for r in recs if r.get("via") == "catchup"),
         "late_votes": int(sum(len(r.get("late", [])) for r in recs)),
+        # the network-vs-crypto split over every late arrival (rows are
+        # [vidx, off, net, sign, via]; pre-ISSUE-14 dumps carry 2-elem
+        # rows and contribute zeros)
+        "late_net_ms": round(sum(
+            row[2] for r in recs for row in r.get("late", [])
+            if len(row) >= 4), 3),
+        "late_sign_ms": round(sum(
+            row[3] for r in recs for row in r.get("late", [])
+            if len(row) >= 4), 3),
         "absent_votes": int(sum(r.get("absent", 0) for r in recs)),
         "late_signers": list(dump.get("late_signers", []))[:16],
     }
@@ -265,15 +274,23 @@ def format_report(rep: dict) -> str:
                      f"catch-up push (no stage timeline)")
     if rep["late_signers"]:
         lines += ["", "chronically late signers (heights late after "
-                      "quorum / absent from commit):"]
+                      "quorum / absent from commit; net = in flight, "
+                      "sign = signed late):"]
         lines.append(f"{'validator':>10}{'late':>7}{'absent':>8}"
-                     f"{'total':>8}")
+                     f"{'total':>8}{'net ms':>10}{'sign ms':>10}")
         for row in rep["late_signers"]:
             lines.append(f"{row['val']:>10}{row['late_heights']:>7}"
-                         f"{row['absent_heights']:>8}{row['total']:>8}")
+                         f"{row['absent_heights']:>8}{row['total']:>8}"
+                         f"{row.get('net_ms', 0.0):>10.3f}"
+                         f"{row.get('sign_ms', 0.0):>10.3f}")
     elif rep["late_votes"] or rep["absent_votes"]:
         lines.append(f"late votes: {rep['late_votes']}, absent "
                      f"precommits: {rep['absent_votes']}")
+    if rep.get("late_net_ms") or rep.get("late_sign_ms"):
+        lines.append(
+            f"late-vote decomposition: {rep['late_net_ms']} ms in "
+            f"flight (network) vs {rep['late_sign_ms']} ms signed "
+            f"late (crypto/host) — see /dump_peers for the hops")
     return "\n".join(lines)
 
 
