@@ -54,41 +54,25 @@ def p50(xs):
 
 
 class CompileWatch:
-    """Accumulates jax.monitoring compile events; per-config deltas ride
-    each result's extra as `jax_compile`. Listeners are process-global
-    and cannot be unregistered, so exactly one watch is ever armed."""
-
-    def __init__(self):
-        self.compiles = 0
-        self.compile_s = 0.0
-        self.pcache_hits = 0
-        self._armed = False
+    """Per-config compile deltas, read from the device observatory's
+    process-global compile ledger (libs/deviceledger) — the ONE
+    jax.monitoring listener bench AND production share, so
+    `extra.jax_compile` here and /dump_devices on a node can never
+    report different compile truth. This class is a thin snapshot
+    adapter kept for the established bench API (snap/delta)."""
 
     def arm(self) -> bool:
-        if self._armed:
-            return True
-        try:
-            from jax import monitoring
-        except Exception:  # noqa: BLE001 - watch is best-effort
-            return False
-        monitoring.register_event_duration_secs_listener(self._on_dur)
-        monitoring.register_event_listener(self._on_event)
-        self._armed = True
-        return True
+        from cometbft_tpu.libs import deviceledger
 
-    def _on_dur(self, key, dur, **kw):
-        if key == "/jax/core/compile/backend_compile_duration":
-            self.compiles += 1
-            self.compile_s += float(dur)
-
-    def _on_event(self, key, **kw):
-        if key == "/jax/compilation_cache/cache_hits":
-            self.pcache_hits += 1
+        return deviceledger.arm_compile_listener()
 
     def snap(self) -> dict:
-        return {"compiles": self.compiles,
-                "compile_s": round(self.compile_s, 3),
-                "pcache_hits": self.pcache_hits}
+        from cometbft_tpu.libs import deviceledger
+
+        c = deviceledger.counters()
+        return {"compiles": c["compiles"],
+                "compile_s": round(c["compile_s"], 3),
+                "pcache_hits": c["pcache_hits"]}
 
     def delta(self, before: dict) -> dict:
         now = self.snap()
@@ -815,7 +799,7 @@ def disabled_flush_bookkeeping_us(k: int = 20_000) -> dict:
         rec = [i, round(t0 / 1e6, 3), 64, 4,
                round((t0 - t0) / 1e6, 3), 0.0, 0.0, 0.0, 0.0, 0,
                PATH_HOST, "closed", 0, 0, 64, 0, 0, 0, 1, 1, 0, 0,
-               t0, t0, gen]
+               0.0, 0.0, 0.0, 0.0, t0, t0, gen, 0]
         t1 = tracing.monotonic_ns()
         rec[5] = round((t1 - t0) / 1e6, 3)
         t2 = tracing.monotonic_ns()
@@ -836,6 +820,45 @@ def disabled_flush_bookkeeping_us(k: int = 20_000) -> dict:
         "disabled_span_us_per_call": round(span_us, 3),
         "note": "always-on ledger + one disabled span, per flush; a "
                 "cfg2 steady iteration is ~10^4x this",
+    }
+
+
+def device_ledger_bookkeeping_us(k: int = 20_000) -> dict:
+    """Per-flush cost of the device observatory's ALWAYS-ON hooks with
+    tracing disabled (ISSUE 15 acceptance: < 10 us/flush).
+
+    Replays the exact per-flush sequence the dispatcher adds for the
+    observatory — one attribution frame push/pop around the dispatch
+    (attr_begin/attr_end), the two extra clock reads bracketing it,
+    and the three in-place ledger stamps (comp/h2d/util) — in
+    isolation. The compile RECORDING path itself is off this budget
+    (compiles are rare, ms-scale events), but it is measured too so a
+    storm can't hide a pathological record cost."""
+    from cometbft_tpu.libs import deviceledger, tracing
+
+    assert not tracing.enabled(), "measure the DISABLED path"
+    led = deviceledger.CompileLedger()
+    rec = [0, 0.0, 0.0, 0, "bench", -1, 0]
+    t0 = _now_ms()
+    for i in range(k):
+        fr = deviceledger.attr_begin("plane.flush", i)
+        a = tracing.monotonic_ns()
+        b = tracing.monotonic_ns()
+        deviceledger.attr_end(fr)
+        rec[2] = round(fr.ms, 3)
+        rec[3] = round(max((b - a) / 1e6 - fr.ms, 0.0), 3)
+        rec[4] = 0.97
+    attr_us = (_now_ms() - t0) * 1000 / k
+    t1 = _now_ms()
+    for i in range(2000):
+        led.record(0.001, False, "bench", i)
+    record_us = (_now_ms() - t1) * 1000 / 2000
+    return {
+        "flush_hook_us_per_flush": round(attr_us, 3),
+        "compile_record_us": round(record_us, 3),
+        "note": "always-on device observatory; the flush-path budget "
+                "is <10us per flush (compile records are rare "
+                "ms-scale events, off that budget)",
     }
 
 
@@ -1497,6 +1520,11 @@ def cfg11_sharded_tally(n_vals=10_000, target_big=100_000):
             "slots_small": b_small,
             "slots_big": b_big,
             "rows_big_target": target_big,
+            # rows-x-cost utilization (the device observatory's util
+            # model): live rows over padded slots swept per pass —
+            # how much of the mesh the flush actually used
+            "util_small": round(real_small / b_small, 4),
+            "util_big": round(real_big / b_big, 4),
             "sharded_small_ms": round(small_ms, 2),
             "sharded_big_ms": round(big_ms, 2),
             "single_device_small_ms": (round(single_ms, 2)
@@ -1611,6 +1639,13 @@ def cfg12_pipelined(n_vals=4096, n_flushes=24):
             "deck_peak": st_deck["deck_peak"],
             "single_airborne_max": sum_1["deck"]["airborne_max"],
             "full_mesh_airborne_max": sum_full["deck"]["airborne_max"],
+            # the device observatory's per-flush split over the deck
+            # arm: utilization (half-mesh flushes should pack denser
+            # than forced-full-mesh ones) + on-device time estimates
+            "util_est": sum_deck["device"]["util"],
+            "util_full_mesh": sum_full["device"]["util"],
+            "dev_ms_est": sum_deck["device"]["dev_ms"],
+            "comp_ms_timed": sum_deck["device"]["comp_ms"],
             "note": "deck-on vs deck-off through the real dispatcher; "
                     "full-mesh arm exercises the drain-first policy",
         },
@@ -2324,6 +2359,208 @@ def smoke_peer_ledger(n_msgs=512):
     }
 
 
+def smoke_device_observatory(n_compiles=64):
+    """cfg15's host-only miniature: the device observatory end to end
+    with no jax in the process — compile-record shape over the
+    attribution stack (site + flush seq + steady flag), the
+    compile_storm incident trigger (burst fires, snapshot carries the
+    compile tail), per-family/per-device residency math over fake
+    tables with the exact-accounting split, and the per-flush hook
+    budget."""
+    from cometbft_tpu.libs import deviceledger, incidents
+
+    led = deviceledger.CompileLedger()
+    old_led = deviceledger.install(led)
+    old_rec = incidents.install(incidents.IncidentRecorder(
+        compile_storm=3, window_s=60.0, cooldown_s=0.0))
+    try:
+        t = _now_ms()
+        for i in range(n_compiles):
+            fr = deviceledger.attr_begin("smoke.flush", i)
+            deviceledger.record_compile(0.002)
+            deviceledger.attr_end(fr)
+        wall_ms = _now_ms() - t
+        recs = led.records()
+        assert set(recs[0]) == set(deviceledger.CompileLedger.FIELDS)
+        assert recs[0]["site"] == "smoke.flush"
+        assert recs[0]["flush_seq"] == 0 and not recs[0]["steady"]
+        c = led.counters()
+        assert c["compiles"] == n_compiles
+        assert c["steady_compiles"] == 0
+        # steady-state recompiles: the round-5 class — a burst past
+        # the threshold fires ONE compile_storm whose snapshot
+        # carries the compile tail naming the sites
+        led.mark_steady()
+        with deviceledger.attr_context("smoke.storm", 99):
+            for _ in range(3):
+                deviceledger.record_compile(0.004)
+        incidents.poke()   # anchor the window
+        incidents.poke()   # evaluate it
+        snaps = incidents.recorder().incidents()
+        assert len(snaps) == 1, [s["trigger"] for s in snaps]
+        assert snaps[0]["trigger"] == "compile_storm"
+        assert any("smoke.storm" in ln and "STEADY" in ln
+                   for ln in snaps[0]["device_tail"]), snaps[0]
+        assert led.counters()["steady_compiles"] == 3
+
+        # residency: fake tables through the same duck-typed split the
+        # real sampler uses — bytes and slots land per device, exactly
+        class _T:
+            def __init__(self, nbytes, n_vals=0, m_shard=0, devs=None):
+                self.nbytes = nbytes
+                self.n_vals = n_vals
+                self.m_shard = m_shard
+                if devs is not None:
+                    self.devs = devs
+
+        fams = deviceledger.residency(
+            tables=[_T(1000, n_vals=4096), _T(500, n_vals=2048)],
+            shards=[_T(901, m_shard=2048, devs=[0, 1, 2, 3])])
+        vt = fams["valset_tables"]
+        assert vt[0]["bytes"] == 1500 and vt[0]["slots"] == 6144
+        sh = fams["shard_tables"]
+        assert sum(s["bytes"] for s in sh.values()) == 901  # exact
+        assert sh[1]["slots"] == 2048
+        head = deviceledger.headroom_rows(fams)
+        assert head[0] == deviceledger.HBM_SLOT_BUDGET - 6144 - 2048
+        assert head[3] == deviceledger.HBM_SLOT_BUDGET - 2048
+        budget = device_ledger_bookkeeping_us(k=2000)
+        return {
+            "metric": "cfg15_smoke device observatory",
+            "value": round(wall_ms, 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "extra": {
+                "compiles": n_compiles,
+                "storm_fired": snaps[0]["trigger"],
+                "flush_hooks": budget,
+                "headroom_dev0": head[0],
+            },
+        }
+    finally:
+        deviceledger.install(old_led)
+        incidents.install(old_rec)
+
+
+def _cfg15_host_machinery():
+    """cfg15 on a no-accelerator host: the observatory MACHINERY at
+    host speed (nothing compiles here — the real compile/residency
+    numbers come from the TPU round; clearly labeled)."""
+    budget = device_ledger_bookkeeping_us()
+    return {
+        "metric": "cfg15 device observatory (host-only MACHINERY run)",
+        "value": budget["flush_hook_us_per_flush"],
+        "unit": "us",
+        "vs_baseline": None,
+        "extra": {
+            "host_only": True,
+            "flush_hooks": budget,
+            "note": "no accelerator: per-flush hook budget only; "
+                    "compile/residency/headroom numbers need the TPU "
+                    "round",
+        },
+    }
+
+
+def cfg15_device(n_vals=1024, steady_reps=5):
+    """#15: the device observatory on the REAL device path — cold
+    compile attribution, zero steady-state recompiles (the r05/round-5
+    guard, asserted), HBM residency + headroom, and the
+    exact-accounting cross-check, all read from the same module core
+    /dump_devices serves.
+
+    Runs LAST in the full set, by which point the plane has long since
+    declared the process steady — so the config installs its OWN fresh
+    compile ledger (the jax listener writes through the module global)
+    and measures cold-vs-steady as this config's delta, not the whole
+    run's. The fresh ledger's dump is what gets embedded for
+    device_report; the process ledger is restored on exit and keeps
+    accumulating the run-wide truth."""
+    import jax
+
+    from cometbft_tpu.libs import deviceledger
+
+    if jax.default_backend() == "cpu":
+        return _cfg15_host_machinery()
+    import numpy as np
+
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.ops import ed25519_cached as ec
+    from cometbft_tpu.ops import ed25519_kernel as ek
+
+    deviceledger.arm_compile_listener()
+    privs = [PrivKey.generate(i.to_bytes(32, "big"))
+             for i in range(1, n_vals + 1)]
+    pubs = [p.pub_key().data for p in privs]
+    msgs = [b"cfg15-%d" % i for i in range(n_vals)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    led = deviceledger.CompileLedger()
+    old_led = deviceledger.install(led)
+    try:
+        with deviceledger.attr_context("cfg15.cold"):
+            table = ec.table_for_pubs(tuple(pubs))
+            m_pad = ec.table_pad(n_vals)
+            pb = ek.pack_batch(pubs, msgs, sigs, pad_to=m_pad)
+            rows = ec.pack_rows_cached(
+                pb, np.zeros((m_pad,), np.bool_),
+                np.zeros((m_pad,), np.int32),
+                np.zeros((1, ek.TALLY_LIMBS), np.int32))
+            out = ec.verify_tally_rows_cached(jax.device_put(rows),
+                                              table, 1)
+            assert bool(np.asarray(out[0])[:n_vals].all())
+        cold = led.counters()
+        led.mark_steady()
+        t = _now_ms()
+        with deviceledger.attr_context("cfg15.steady"):
+            for _ in range(steady_reps):
+                out = ec.verify_tally_rows_cached(
+                    jax.device_put(rows), table, 1)
+            np.asarray(out[0])
+        steady_ms = (_now_ms() - t) / steady_reps
+        after = led.counters()
+        steady_compiles = after["steady_compiles"]
+        # THE acceptance: a steady-state verify stream recompiles
+        # nothing (the round-5 class the compile_storm trigger
+        # watches) — measured on THIS config's fresh ledger, so the
+        # earlier configs' compiles can't pollute it either way
+        assert steady_compiles == 0, \
+            f"steady-state recompiled {steady_compiles}x"
+        fams = deviceledger.residency()
+        rec = deviceledger.reconcile(fams)
+        assert rec["table_drift"] == 0, rec
+        head = deviceledger.headroom_rows(fams)
+        dump = deviceledger.dump_devices()
+        dump["compiles"] = dump["compiles"][-32:]
+        return {
+            "metric": "cfg15 device observatory steady verify",
+            "value": round(steady_ms, 2),
+            "unit": "ms",
+            "vs_baseline": None,
+            "extra": {
+                "cold_compiles": cold["compiles"],
+                "cold_compile_s": round(cold["compile_s"], 3),
+                "pcache_hits": after["pcache_hits"],
+                "steady_compiles": steady_compiles,
+                "resident_bytes": {
+                    fam: sum(s["bytes"] for s in devs.values())
+                    for fam, devs in fams.items()},
+                "headroom_rows_min": min(head.values()) if head
+                else None,
+                "reconcile": rec,
+                "compile_sites": [r["site"]
+                                  for r in led.records()[-8:]],
+                # the config's own dump (compile ring trimmed) so
+                # tools/device_report.py can read this --json-out
+                # file directly and --diff it against the next
+                # round's; extra.jax_compile reads ~0 for cfg15 by
+                # design (its compiles land on this private ledger)
+                "device_dump": dump,
+            },
+        }
+    finally:
+        deviceledger.install(old_led)
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
@@ -2331,7 +2568,8 @@ SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg11_smoke", smoke_sharded_layout),
                  ("cfg12_smoke", smoke_pipelined_deck),
                  ("cfg13_smoke", smoke_churn_warmer),
-                 ("cfg14_smoke", smoke_peer_ledger)]
+                 ("cfg14_smoke", smoke_peer_ledger),
+                 ("cfg15_smoke", smoke_device_observatory)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -2345,7 +2583,8 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg7", cfg7_pack_only), ("cfg8", cfg8_multichip_smoke),
                 ("cfg9", cfg9_sustained), ("cfg10", cfg10_gateway),
                 ("cfg11", cfg11_sharded_tally),
-                ("cfg12", cfg12_pipelined), ("cfg13", cfg13_churn)]
+                ("cfg12", cfg12_pipelined), ("cfg13", cfg13_churn),
+                ("cfg15", cfg15_device)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 
@@ -2420,13 +2659,19 @@ def main(argv=None):
     watch = CompileWatch()
     watch.arm()
 
+    from cometbft_tpu.libs import deviceledger
+
     for name, fn in FULL_CONFIGS:
         traced = bool(args.trace_out) and name in TRACED_CONFIGS
         if traced:
             tracing.enable(capacity=1 << 18)
         compile_before = watch.snap()
         try:
-            r = fn()
+            # the attribution frame names this config as the compile
+            # site in /dump_devices (plane flushes carry their own
+            # richer per-flush frames on the dispatcher thread)
+            with deviceledger.attr_context(f"bench.{name}"):
+                r = fn()
         except Exception as e:  # a config failure must not kill the run
             r = {"metric": f"{name} FAILED", "value": None, "unit": "",
                  "vs_baseline": None, "extra": {"error": repr(e)[:300]}}

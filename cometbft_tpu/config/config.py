@@ -318,6 +318,7 @@ class IncidentsConfig:
     breaker_flaps: int = 4         # breaker transitions inside window_s
     shed_storm: int = 256          # sheddable-lane sheds inside window_s
     peer_starvation: int = 64      # p2p send-queue stalls inside window_s
+    compile_storm: int = 3         # steady-state recompiles inside window_s
     window_s: float = 10.0         # flap/storm evaluation window
     cooldown_s: float = 30.0       # per-trigger-kind re-arm time
 
@@ -330,6 +331,7 @@ class IncidentsConfig:
             breaker_flaps=self.breaker_flaps,
             shed_storm=self.shed_storm,
             peer_starvation=self.peer_starvation,
+            compile_storm=self.compile_storm,
             window_s=self.window_s,
             cooldown_s=self.cooldown_s,
         )
@@ -449,10 +451,11 @@ class Config:
             if getattr(inc, name) < 0:
                 raise ConfigError(f"[incidents] {name} must be >= 0")
         if inc.round_limit < 1 or inc.breaker_flaps < 1 \
-                or inc.shed_storm < 1 or inc.peer_starvation < 1:
+                or inc.shed_storm < 1 or inc.peer_starvation < 1 \
+                or inc.compile_storm < 1:
             raise ConfigError(
                 "[incidents] round_limit/breaker_flaps/shed_storm/"
-                "peer_starvation must be >= 1")
+                "peer_starvation/compile_storm must be >= 1")
         if self.failpoints.spec:
             # parse-validate without arming: a typo'd spec must fail at
             # config load, not silently never fire

@@ -27,6 +27,12 @@ instants and the snapshots replay byte-identically):
   * ``shed_storm``    — >= ``shed_storm`` sheddable-lane sheds inside
     ``window_s`` (the overload machinery is the only thing keeping the
     node alive — an operator should know NOW, not at the next scrape).
+  * ``compile_storm`` — >= ``compile_storm`` STEADY-STATE backend
+    compiles inside ``window_s`` (fed by the device observatory,
+    libs/deviceledger: once the flush shapes are declared compiled,
+    recompiles are the round-5 regression class — per-call shard_map
+    rebuilds — and the snapshot freezes the compile tail naming the
+    triggering sites/flushes).
   * ``peer_starvation`` — >= ``peer_starvation`` p2p send-queue stalls
     (blocked puts + full-queue drops, counted by the peer ledger)
     inside ``window_s``: gossip is backing up, so votes are about to
@@ -56,7 +62,7 @@ fp.register("incidents.force",
 INCIDENT_CAPACITY = 32
 
 TRIGGERS = ("commit_stall", "round_escalation", "breaker_flap",
-            "shed_storm", "peer_starvation", "forced")
+            "shed_storm", "peer_starvation", "compile_storm", "forced")
 
 
 class IncidentRecorder:
@@ -67,6 +73,7 @@ class IncidentRecorder:
     def __init__(self, commit_stall_s: float = 20.0,
                  round_limit: int = 4, breaker_flaps: int = 4,
                  shed_storm: int = 256, peer_starvation: int = 64,
+                 compile_storm: int = 3,
                  window_s: float = 10.0,
                  cooldown_s: float = 30.0,
                  capacity: int = INCIDENT_CAPACITY):
@@ -75,6 +82,7 @@ class IncidentRecorder:
         self.breaker_flaps = int(breaker_flaps)
         self.shed_storm = int(shed_storm)
         self.peer_starvation = int(peer_starvation)
+        self.compile_storm = int(compile_storm)
         self.window_s = float(window_s)
         self.cooldown_s = float(cooldown_s)
         self._ring: deque = deque(maxlen=max(4, int(capacity)))
@@ -91,6 +99,8 @@ class IncidentRecorder:
         self._shed_win = (0, 0)
         # peer-starvation window: (window start ns, queue stalls since)
         self._peer_win = (0, 0)
+        # compile-storm window: (window start ns, steady compiles since)
+        self._comp_win = (0, 0)
         self._fingerprint: Optional[dict] = None
         # real-clock watchdog ticker (production only): a quorumless
         # partition wedges the step machine with NO transitions — the
@@ -115,6 +125,7 @@ class IncidentRecorder:
                 "breaker_flaps": self.breaker_flaps,
                 "shed_storm": self.shed_storm,
                 "peer_starvation": self.peer_starvation,
+                "compile_storm": self.compile_storm,
                 "window_s": self.window_s,
                 "cooldown_s": self.cooldown_s}
 
@@ -146,6 +157,24 @@ class IncidentRecorder:
             start, count = self._peer_win
             self._peer_win = (start, count + n)
 
+    def note_compile(self, n: int = 1) -> None:
+        """STEADY-STATE backend compiles (the device observatory's
+        compile ledger calls this for every recompile after the
+        process declared its shapes compiled) — accumulated into the
+        storm window; the NEXT poke evaluates it. Unlike the shed/peer
+        windows this one anchors at NOTE time (first count of an
+        accumulation run), not at poke time: a compile storm is a
+        short burst (a few rebuilds inside one flush), and a stale
+        poke-time anchor would expire-and-discard exactly that burst.
+        Compiles land on whichever thread compiled (dispatcher,
+        warmer, bench), so the same lock discipline applies."""
+        t = tracing.monotonic_ns()
+        with self._lock:
+            start, count = self._comp_win
+            if not count:
+                start = t
+            self._comp_win = (start, count + n)
+
     def poke(self, height: int = 0, round_: int = 0) -> None:
         """Evaluate every trigger. Called on each consensus step
         transition — cheap when nothing is wrong: a clock read and a
@@ -161,6 +190,7 @@ class IncidentRecorder:
                 self._brk_win = (0, -1)
                 self._shed_win = (0, 0)
                 self._peer_win = (0, 0)
+                self._comp_win = (0, 0)
             return
         try:
             fp.fail_point("incidents.force")
@@ -181,6 +211,7 @@ class IncidentRecorder:
         self._check_breaker(now, height, round_)
         self._check_sheds(now, height, round_)
         self._check_peer_stalls(now, height, round_)
+        self._check_compiles(now, height, round_)
 
     def _check_breaker(self, now: int, height: int, round_: int) -> None:
         # read the device breaker only when its module already loaded —
@@ -253,6 +284,26 @@ class IncidentRecorder:
         self._fire("peer_starvation", now, height, round_,
                    {"stalls": count, "window_s": self.window_s})
 
+    def _check_compiles(self, now: int, height: int,
+                        round_: int) -> None:
+        # expiry BEFORE the threshold, like the shed window (a wedged
+        # poker waking late must report a slow drip of recompiles as a
+        # drip, not a storm); the anchor is the run's FIRST note, so a
+        # genuine burst fires on the first poke after it regardless of
+        # how long the system sat quiet before
+        with self._lock:
+            start, count = self._comp_win
+            if not count:
+                return
+            if now - start > self.window_s * 1e9:
+                self._comp_win = (0, 0)
+                return
+            if count < self.compile_storm:
+                return
+            self._comp_win = (0, 0)
+        self._fire("compile_storm", now, height, round_,
+                   {"steady_compiles": count, "window_s": self.window_s})
+
     # -- the real-clock watchdog ticker (node lifecycle) -------------------
 
     def start_watchdog(self) -> None:
@@ -323,6 +374,7 @@ class IncidentRecorder:
             "flush_tail": [],
             "height_tail": [],
             "peer_tail": [],
+            "device_tail": [],
             "trace_tail": tracing.tail(24),
             "counters": self._counters(),
             "fingerprint": self._fingerprint,
@@ -346,6 +398,14 @@ class IncidentRecorder:
                 # starving / which links were eating messages at the
                 # instant the trigger fired
                 snap["peer_tail"] = pl.ledger_tail(8)
+            except Exception:  # noqa: BLE001
+                pass
+        dl = sys.modules.get("cometbft_tpu.libs.deviceledger")
+        if dl is not None:
+            try:
+                # the compile tail names WHICH sites/flushes paid the
+                # recompiles a compile_storm fired on
+                snap["device_tail"] = dl.ledger_tail(8)
             except Exception:  # noqa: BLE001
                 pass
         return snap
@@ -388,6 +448,12 @@ class IncidentRecorder:
                                 "blocked_puts": s["blocked_puts"],
                                 "full_drops": s["full_drops"],
                                 "link_drops": s["link_drops"]}
+            except Exception:  # noqa: BLE001
+                pass
+        dl = sys.modules.get("cometbft_tpu.libs.deviceledger")
+        if dl is not None:
+            try:
+                out["device"] = dl.counters()
             except Exception:  # noqa: BLE001
                 pass
         return out
@@ -473,6 +539,10 @@ def note_shed(n: int = 1) -> None:
 
 def note_peer_stall(n: int = 1) -> None:
     _RECORDER.note_peer_stall(n)
+
+
+def note_compile(n: int = 1) -> None:
+    _RECORDER.note_compile(n)
 
 
 def dump_incidents() -> dict:

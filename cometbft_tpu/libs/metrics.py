@@ -498,6 +498,37 @@ class NodeMetrics:
         self.wal_fsync_seconds = r.counter(
             "wal", "fsync_seconds_total",
             "Cumulative WAL fsync wall time")
+        # device observatory (libs/deviceledger.py): the process-global
+        # compile ledger + HBM residency accounting, sampled at scrape
+        # time (the core is jax-free, so a scrape never pays a cold
+        # jax import; the ledger only fills once something compiled)
+        self.device_compiles = r.counter(
+            "device", "compiles_total",
+            "jax backend compiles recorded by the device observatory, "
+            "labeled phase=cold (before the steady-state declaration) "
+            "or phase=steady (after — the round-5 recompile "
+            "regression class the compile_storm incident watches)")
+        self.device_compile_seconds = r.counter(
+            "device", "compile_seconds_total",
+            "Total wall seconds of recorded backend compiles")
+        self.device_pcache_hits = r.counter(
+            "device", "compile_pcache_hits_total",
+            "Compiles absorbed by the persistent jax compilation "
+            "cache instead of a backend compile")
+        self.device_resident = r.gauge(
+            "device", "resident_bytes",
+            "Bytes pinned per residency family per device "
+            "(family=valset_tables|shard_tables|staging|combs; "
+            "dev=chip id or 'host' for pinned staging)")
+        self.device_headroom = r.gauge(
+            "device", "hbm_headroom_rows",
+            "Valset-slot headroom per chip against the 65536-slot "
+            "window-table budget (negative = retired epochs pin more "
+            "table rows than one chip serves live)")
+        self.device_ledger_records = r.gauge(
+            "device", "compile_ledger_records",
+            "Compile events currently held by the bounded compile "
+            "ledger ring")
 
     def _sample(self) -> None:
         """Scrape-time refresh of the push-less internals. Modules that
@@ -675,6 +706,39 @@ class NodeMetrics:
             for kind, n in rec.fired.items():
                 self.incidents_fired._set((("trigger", kind),),
                                           float(n))
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            # device observatory: counters from the compile ledger,
+            # residency per family/device, per-chip headroom — all
+            # jax-free reads (heavy modules only via sys.modules
+            # inside residency())
+            from cometbft_tpu.libs import deviceledger
+
+            c = deviceledger.counters()
+            steady = float(c["steady_compiles"])
+            self.device_compiles._set((("phase", "cold"),),
+                                      float(c["compiles"]) - steady)
+            self.device_compiles._set((("phase", "steady"),), steady)
+            self.device_compile_seconds._set((),
+                                             float(c["compile_s"]))
+            self.device_pcache_hits._set((), float(c["pcache_hits"]))
+            self.device_ledger_records.set(
+                float(len(deviceledger.ledger())))
+            fams = deviceledger.residency()
+            # drop stale label sets first: an evicted family/device
+            # must vanish from the scrape, not freeze at its last
+            # pre-eviction value (gauges are point-in-time state)
+            with self.device_resident._lock:
+                self.device_resident._values.clear()
+            with self.device_headroom._lock:
+                self.device_headroom._values.clear()
+            for fam, devs in fams.items():
+                for dev, slot in devs.items():
+                    self.device_resident.set(
+                        float(slot["bytes"]), family=fam, dev=str(dev))
+            for dev, n in deviceledger.headroom_rows(fams).items():
+                self.device_headroom.set(float(n), dev=str(dev))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:

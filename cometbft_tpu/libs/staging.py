@@ -34,9 +34,21 @@ free a cached table buffer.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Tuple
+import weakref
+from typing import Dict, List, Tuple
 
 import numpy as np
+
+# every live pool, weakly held: the device observatory's residency
+# sampler (libs/deviceledger) attributes ALL pinned staging bytes —
+# the global crypto.batch pool, plane-private pools, blocksync's —
+# without each owner having to register anywhere
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_pools() -> List["StagingPool"]:
+    """Snapshot of every StagingPool still alive in this process."""
+    return list(_POOLS)
 
 
 class StagingPool:
@@ -49,6 +61,7 @@ class StagingPool:
         self._next: Dict[tuple, int] = {}
         self.hits = 0
         self.misses = 0
+        _POOLS.add(self)
 
     def get(self, name: str, shape: Tuple[int, ...], dtype,
             zero: bool = True) -> np.ndarray:

@@ -367,6 +367,13 @@ class Node(BaseService):
         from cometbft_tpu.libs import incidents
 
         incidents.recorder().start_watchdog()
+        # device observatory: arm the process-global compile listener
+        # (no-op until jax is actually in the process — a host-only
+        # node never pays a cold jax import for it; the verify plane
+        # re-arms at start when it dispatches to a device)
+        from cometbft_tpu.libs import deviceledger
+
+        deviceledger.arm_compile_listener()
         if self.verify_plane is not None:
             from cometbft_tpu import verifyplane
 

@@ -209,6 +209,15 @@ def stats() -> dict:
         return dict(STATS)
 
 
+def snapshot_values(kind: str) -> list:
+    """The entries of one cache, snapshotted under :data:`LOCK`
+    WITHOUT refreshing recency — the device observatory's residency
+    sampler (libs/deviceledger) walks these to attribute per-device
+    bytes/slots; a scrape must never perturb eviction order."""
+    with LOCK:
+        return list(_CACHES[kind]._od.values())
+
+
 def resident_bytes() -> int:
     """Host+device bytes pinned by the TABLE caches (the memo caches
     pin only references whose owners are sized elsewhere)."""

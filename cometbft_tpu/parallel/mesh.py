@@ -88,6 +88,34 @@ def _cache_get(key):
     return fn
 
 
+def _cache_put(key, fn):
+    """Memoize a freshly-built step, wrapped so its FIRST invocation
+    attributes the lazy jit trace/compile to this builder in the
+    device observatory's compile ledger (libs/deviceledger) — unless
+    a richer frame (the verify plane's per-flush attribution, a bench
+    config) is already active on the calling thread, in which case
+    that frame keeps the credit. After the first call the wrapper is
+    a list check: steady-state dispatch cost is untouched."""
+    from cometbft_tpu.libs import deviceledger
+
+    site = f"mesh.step:{key[0]}"
+    done: list = []
+
+    def wrapped(*args):
+        if done:
+            return fn(*args)
+        fr = deviceledger.attr_begin_fallback(site)
+        try:
+            return fn(*args)
+        finally:
+            done.append(1)
+            if fr is not None:
+                deviceledger.attr_end(fr)
+
+    _STEP_CACHE[key] = wrapped
+    return wrapped
+
+
 def _mesh_key(mesh: Mesh):
     return (tuple(mesh.axis_names), tuple(mesh.devices.flat))
 
@@ -132,8 +160,7 @@ def sharded_verify_tally(mesh: Mesh, n_commits: int):
         out_specs=(bspec, rspec, rspec),
     )
     fn = jax.jit(sharded)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)
 
 
 def _sharded_verify_rows_step(mesh: Mesh):
@@ -170,8 +197,7 @@ def _sharded_verify_rows_step(mesh: Mesh):
         unchecked=True,
     )
     fn = jax.jit(sharded)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)
 
 
 def _sharded_tally_step(mesh: Mesh, n_commits: int):
@@ -197,8 +223,7 @@ def _sharded_tally_step(mesh: Mesh, n_commits: int):
         unchecked=True,
     )
     fn = jax.jit(sharded)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)
 
 
 def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
@@ -229,8 +254,7 @@ def sharded_verify_tally_rows(mesh: Mesh, n_commits: int):
                               threshold)
         return valid, total, quorum
 
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)
 
 
 def shard_batch_arrays(mesh: Mesh, pb: ek.PackedBatch, power5, counted,
@@ -309,8 +333,7 @@ def sharded_stream_verify(mesh: Mesh, n_commits: int):
         unchecked=True,
     )
     fn = jax.jit(sharded)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)
 
 
 def sharded_fused_verify(mesh: Mesh, n_commits: int):
@@ -364,5 +387,4 @@ def sharded_fused_verify(mesh: Mesh, n_commits: int):
         unchecked=True,
     )
     fn = jax.jit(sharded)
-    _STEP_CACHE[key] = fn
-    return fn
+    return _cache_put(key, fn)

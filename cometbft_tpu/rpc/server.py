@@ -621,6 +621,19 @@ class Routes:
             return led.dump()
         return peerledger.dump_peers()
 
+    def dump_devices(self):
+        """The device observatory (libs/deviceledger.py): the compile
+        ledger (every jax backend compile with site/flush attribution
+        and the steady-state flag), per-family/per-device HBM
+        residency with headroom against the 65536-slot table budget,
+        the exact-accounting cross-check, and the flush ledger's
+        device-time summary (also served as GET /dump_devices). The
+        ledger is process-global and always on — history survives the
+        node stopping, like every other dump route."""
+        from cometbft_tpu.libs import deviceledger
+
+        return deviceledger.dump_devices()
+
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
 
@@ -710,7 +723,7 @@ _ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes", "dump_heights",
-    "dump_incidents", "dump_peers",
+    "dump_incidents", "dump_peers", "dump_devices",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -831,7 +844,7 @@ class _Handler(BaseHTTPRequestHandler):
         # the always-on flush/height ledgers, incident snapshots
         if url.path in ("/dump_traces", "/dump_flushes",
                         "/dump_heights", "/dump_incidents",
-                        "/dump_peers"):
+                        "/dump_peers", "/dump_devices"):
             self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):

@@ -69,7 +69,7 @@ class _Plan:
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
                  "counted_pos", "n_commits", "pubs_v", "powers_v",
                  "pending", "mesh", "n_dev", "thresh", "devs",
-                 "drain_first", "warm")
+                 "drain_first", "warm", "util")
 
 
 def _eligible(batch):
@@ -377,6 +377,11 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
     # dispatch_fused; the plane stamps it into the ledger's warm
     # column so post-rotation cold builds are attributable)
     plan.warm = False
+    # rows-x-cost utilization: the fraction of the staged device pass
+    # doing real work (n live rows over the B padded slots the kernel
+    # sweeps across the whole fan-out) — the ledger's util column, so
+    # cfg11/cfg12 report how much of the mesh a flush actually used
+    plan.util = round(n / B, 4) if B else 0.0
     return plan
 
 

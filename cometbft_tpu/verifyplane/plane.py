@@ -84,6 +84,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from cometbft_tpu.libs import deviceledger
 from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs import tracing
 
@@ -156,10 +157,39 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
  _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_BRK, _L_SMISS,
  _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
- _L_NHOST, _L_DEV0, _L_WARM) = range(22)
-# internal slots past the FIELDS window: two ns stamps + the clock
-# generation they were taken under (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN = 22, 23, 24
+ _L_NHOST, _L_DEV0, _L_WARM, _L_COMP, _L_H2D, _L_DEV,
+ _L_UTIL) = range(26)
+# internal slots past the FIELDS window: ns stamps + the clock
+# generation they were taken under + the first-ready probe stamp
+# (readers never see these)
+_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 26, 27, 28, 29
+
+
+def _device_block(cols: dict) -> dict:
+    """The summary's device-time attribution over the ring's columns:
+    compile ms total (and which flushes paid it), plus h2d/dev/util
+    percentiles over the FUSED flushes that actually measured them
+    (host-path zeros would drown the signal)."""
+    from cometbft_tpu.libs.quantiles import nearest_rank
+
+    fused = [i for i, p in enumerate(cols["path"])
+             if p in (PATH_FUSED, PATH_FUSED_SHARDED)]
+
+    def pcts(name):
+        xs = sorted(cols[name][i] for i in fused)
+        if not xs:
+            return {"p50": 0.0, "p90": 0.0, "max": 0.0}
+        return {"p50": nearest_rank(xs, 0.5),
+                "p90": nearest_rank(xs, 0.9), "max": xs[-1]}
+
+    return {
+        "comp_ms": round(sum(cols["comp_ms"]), 3),
+        "comp_flushes": sum(1 for c in cols["comp_ms"] if c),
+        "fused_flushes": len(fused),
+        "h2d_ms": pcts("h2d_ms"),
+        "dev_ms": pcts("dev_ms"),
+        "util": pcts("util"),
+    }
 
 
 class FlushLedger:
@@ -183,7 +213,20 @@ class FlushLedger:
     — and ``warm``: 1 when a fused flush found its valset window table
     already cached (LRU hit), 0 when it paid the build/patch inline
     (the cold first-commit-after-rotation stall the next-epoch table
-    warmer exists to kill; non-table paths record 0).
+    warmer exists to kill; non-table paths record 0) — and the
+    DEVICE-TIME split (the device observatory, libs/deviceledger):
+    ``comp_ms`` = jax backend-compile ms attributed to THIS flush
+    (cold post-rotation compiles become visible on the flush that
+    paid them; a nonzero value on a steady flush is the round-5
+    regression class), ``h2d_ms`` = the host-side dispatch wall
+    (device_put staging + kernel enqueue) net of comp_ms, ``dev_ms``
+    = the estimated on-device time (dispatch -> first true readiness
+    probe when the deck observed one, else dispatch -> fetch
+    complete, an upper bound including d2h), and ``util`` = real rows
+    / padded device slots staged (the rows-x-cost utilization of the
+    pass; 0 on non-fused paths). comp_ms and h2d_ms decompose part
+    of pack_ms (dispatch runs inside the pack span); dev_ms overlaps
+    flight+collect.
     Written by the dispatcher even when tracing is off; read by
     /dump_flushes, the scrape-time /metrics percentiles, and simnet
     replay blobs."""
@@ -192,7 +235,8 @@ class FlushLedger:
               "flight_ms", "collect_ms", "settle_ms", "airborne",
               "path", "breaker", "staging_miss", "depth",
               "c_rows", "g_rows", "b_rows", "shed", "n_dev",
-              "n_host", "dev0", "warm")
+              "n_host", "dev0", "warm", "comp_ms", "h2d_ms",
+              "dev_ms", "util")
 
     __slots__ = ("_ring",)
 
@@ -236,6 +280,7 @@ class FlushLedger:
                 + (" cold" if r[_L_PATH] in (PATH_FUSED,
                                              PATH_FUSED_SHARDED)
                    and not r[_L_WARM] else "")
+                + (f" comp={r[_L_COMP]}ms" if r[_L_COMP] else "")
             )
         return out
 
@@ -295,6 +340,12 @@ class FlushLedger:
                 "overlapped_flushes": sum(
                     1 for a in cols["airborne"] if a),
             },
+            # device-time attribution (the device observatory,
+            # /dump_devices): total backend-compile ms charged to
+            # flushes in the window (nonzero on a steady stream = the
+            # round-5 class), and the h2d/on-device/utilization
+            # figures over the fused flushes that measured them
+            "device": _device_block(cols),
             # valset-table attribution over the fused paths: cold = a
             # flush that paid the table build/patch inline (the
             # post-rotation stall /dump_flushes localizes; the warmer
@@ -634,6 +685,12 @@ class VerifyPlane:
         self.deck_airborne = 0     # flights airborne right now
         self.deck_peak = 0         # deepest the deck ever got
         self._packs = 0            # pack ordinal (rotation-window bound)
+        # device observatory: successful fused collects before this
+        # plane declares the process steady (deviceledger.mark_steady),
+        # and whether the compile listener armed yet (start() may be
+        # refused pre-jax; the dispatch seam re-arms lazily)
+        self._steady_flushes = 0
+        self._listener_armed = False
         # always-on flush ledger (bounded ring; survives stop() — it is
         # read-only history, never cleared by the lifecycle)
         self.ledger = FlushLedger()
@@ -654,6 +711,13 @@ class VerifyPlane:
             if self._running:
                 return
             self._running = True
+        if self._use_device:
+            # device observatory: a device-dispatching plane means jax
+            # is (or is about to be) live in this process — arm the
+            # process-global compile listener so every compile this
+            # plane's flushes trigger lands in /dump_devices (refused
+            # before jax imports; the dispatch seam re-arms lazily)
+            self._listener_armed = deviceledger.arm_compile_listener()
         self._thread = threading.Thread(
             target=self._run, name="verify-plane", daemon=True
         )
@@ -714,7 +778,7 @@ class VerifyPlane:
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
                 0, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
-                1, 0, 0,
+                1, 0, 0, 0.0, 0.0, 0.0, 0.0,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -994,7 +1058,7 @@ class VerifyPlane:
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, 0, PATH_SHED_ONLY,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed), 0, 0, 0, 0,
+                        len(shed), 0, 0, 0, 0, 0.0, 0.0, 0.0, 0.0,
                     ])
             if not batch:
                 # nothing to pack: land a flight (the first READY one,
@@ -1114,6 +1178,10 @@ class VerifyPlane:
             flight.led)
         traced = tracing.enabled()
         t_exec = tracing.monotonic_ns()
+        # collect-time compiles (first grouped-path kernel build, a
+        # faulted flight's host fallback re-trace) attribute to this
+        # flush too — comp_ms must name every compile the flush paid
+        attr = deviceledger.attr_begin("plane.collect", led[_L_SEQ])
         if airborne:
             if traced:
                 with tracing.span("plane.collect", cat="verifyplane",
@@ -1131,6 +1199,9 @@ class VerifyPlane:
                     verdicts, fused_tallies = finish()
             else:
                 verdicts, fused_tallies = finish()
+        deviceledger.attr_end(attr)
+        if attr.ms:
+            led[_L_COMP] = round(led[_L_COMP] + attr.ms, 3)
         t_settle = tracing.monotonic_ns()
         if traced:
             with tracing.span("plane.settle", cat="verifyplane",
@@ -1152,6 +1223,14 @@ class VerifyPlane:
         if tracing.clock_gen() == led[_L_GEN]:
             if airborne:
                 led[_L_FLIGHT] = round((t_exec - led[_L_TPACKED]) / 1e6, 3)
+                # on-device time estimate: dispatch -> the first TRUE
+                # readiness probe when the deck observed one (the
+                # kernel-flight figure), else dispatch -> fetch done
+                # (an upper bound that includes the d2h copy)
+                ready_ns = led[_L_READY]
+                led[_L_DEV] = round(
+                    ((ready_ns if ready_ns else t_settle)
+                     - led[_L_TPACKED]) / 1e6, 3)
             led[_L_COLLECT] = round((t_settle - t_exec) / 1e6, 3)
             led[_L_SETTLE] = round((t_done - t_settle) / 1e6, 3)
         self.ledger.record(led)
@@ -1205,12 +1284,12 @@ class VerifyPlane:
         queued_ms = round((t0 - t_min) / 1e6, 3) if t_min is not None \
             else 0.0
         # FIELDS-ordered record + internal slots (t0, t_packed, clock
-        # gen); this list IS the eventual ring slot
+        # gen, first-ready stamp); this list IS the eventual ring slot
         led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, 0,
                PATH_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
-               0, 0, t0, t0, gen]
+               0, 0, 0.0, 0.0, 0.0, 0.0, t0, t0, gen, 0]
         for s in batch:
             # the join key consumers read AFTER the future resolves
             # (height ledger -> /dump_flushes attribution)
@@ -1229,6 +1308,18 @@ class VerifyPlane:
         t1 = tracing.monotonic_ns()
         led[_L_PACK] = round((t1 - t0) / 1e6, 3)
         led[_L_TPACKED] = t1
+        if ready is not None:
+            # wrap the readiness probe to stamp the FIRST true reading
+            # (dispatcher thread only): dev_ms = dispatch -> kernel
+            # done, the observatory's on-device time estimate
+            def probe(inner=ready, led=led):
+                ok = inner()
+                if ok and not led[_L_READY] \
+                        and tracing.clock_gen() == led[_L_GEN]:
+                    led[_L_READY] = tracing.monotonic_ns()
+                return ok
+
+            ready = probe
         return _Flight(batch, finish, airborne, fid, led, devs, ready,
                        pack_idx=self._packs)
 
@@ -1288,6 +1379,14 @@ class VerifyPlane:
             return (lambda: (_host_verdicts(rows), None)), False, \
                 None, None
         plan = None
+        if self._use_device:
+            # lazy re-arm: start()'s attempt is refused when jax was
+            # not yet imported (kernels-injected planes); by the first
+            # device dispatch it must be — a plane-level flag keeps
+            # the steady-state cost at one attribute check
+            if not self._listener_armed:
+                self._listener_armed = \
+                    deviceledger.arm_compile_listener()
         if self._use_device and self._kernels is None:
             from cometbft_tpu.verifyplane import fused as fz
 
@@ -1311,17 +1410,33 @@ class VerifyPlane:
                 # instead of queueing piecemeal behind the halves
                 while deck:
                     self._land_one(deck)
+            # device observatory attribution: every backend compile
+            # landing during THIS dispatch (mesh step rebuild, cold
+            # table build, new bucket shape) is charged to this flush
+            # — comp_ms in the ledger, site/flush_seq in /dump_devices
+            attr = deviceledger.attr_begin("plane.flush", led[_L_SEQ])
             try:
                 # [tracing] profile_dir: bracket the device flight with
                 # a jax.profiler capture so device traces line up with
                 # the host spans (no-op unless configured)
                 prof = tracing.profiler_stop if tracing.profiler_start() \
                     else None
+                t_d0 = tracing.monotonic_ns()
                 fz.dispatch_fused(plan)
+                t_d1 = tracing.monotonic_ns()
+                deviceledger.attr_end(attr)
                 tracing.flight_begin("plane.flight", fid,
                                      cat="verifyplane", rows=len(rows))
                 self._observe_pack(time.perf_counter() - t0,
                                    fz.plan_h2d_bytes(plan))
+                led[_L_COMP] = round(attr.ms, 3)
+                led[_L_UTIL] = plan.util
+                if tracing.clock_gen() == led[_L_GEN]:
+                    # h2d estimate: the synchronous dispatch wall
+                    # (device_put staging + kernel enqueue) net of the
+                    # compile time attributed above
+                    led[_L_H2D] = round(
+                        max((t_d1 - t_d0) / 1e6 - attr.ms, 0.0), 3)
                 if plan.mesh is not None:
                     led[_L_PATH] = PATH_FUSED_SHARDED
                     led[_L_NDEV] = plan.n_dev
@@ -1360,6 +1475,13 @@ class VerifyPlane:
                         if prof is not None:
                             prof()
                     self._breaker.record_success()
+                    # device observatory steady declaration: after two
+                    # successful fused collects the flush shapes are
+                    # compiled — any further backend compile is the
+                    # round-5 regression class (compile_storm watches)
+                    self._steady_flushes += 1
+                    if self._steady_flushes == 2:
+                        deviceledger.mark_steady()
                     if plan.mesh is not None:
                         # counted on COLLECT success: only completed
                         # cross-chip passes are attributed sharded
@@ -1375,6 +1497,10 @@ class VerifyPlane:
                 return finish, True, plan.devs, \
                     (lambda: fz.plan_ready(plan))
             except Exception:  # noqa: BLE001 - device fault at dispatch
+                deviceledger.attr_end(attr)
+                # compiles a FAILED dispatch paid still belong to this
+                # flush (the grouped/host fallback below records it)
+                led[_L_COMP] = round(attr.ms, 3)
                 if prof is not None:
                     prof()  # un-bracket a failed dispatch
                 self._breaker.record_failure()

@@ -609,6 +609,66 @@ fz.dispatch_fused = real_dispatch_e
 fz.collect_fused = real_collect_e
 fz.plan_ready = real_ready_e
 
+# ---- phase H: the device observatory catches a broken step memo ---------
+# The round-5 bug class END TO END on the real dispatcher: the jitted
+# sharded steps in this subprocess compile for REAL (only the Pallas
+# kernel inside them is stubbed), so clearing parallel.mesh's step memo
+# — exactly what the round-5 per-call shard_map rebuild regression did
+# — makes the next flush re-trace and re-compile. The compile ledger
+# must record the recompiles STEADY, attribute them to the flush that
+# paid (site=plane.flush, flush_seq joining /dump_flushes' comp_ms
+# column), and the compile_storm incident must fire with the compile
+# tail frozen in its snapshot.
+
+from cometbft_tpu.libs import deviceledger, incidents  # noqa: E402
+
+assert deviceledger.arm_compile_listener(), "jax is live here"
+old_rec = incidents.install(incidents.IncidentRecorder(
+    compile_storm=1, window_s=600.0, cooldown_s=0.0))
+# the plane already declared steady itself (two successful fused
+# collects back in the early phases) — assert that, then watermark the
+# compile ring so the joins below only see phase-H records
+assert deviceledger.is_steady(), \
+    "the plane's own steady declaration never fired"
+steady_before = deviceledger.counters()["steady_compiles"]
+_pre = deviceledger.ledger().records()
+watermark = _pre[-1]["seq"] if _pre else -1
+
+pm._STEP_CACHE.clear()  # the round-5 regression, deliberately
+
+plane_h = VerifyPlane(window_ms=40.0, max_batch=4096, use_device=True,
+                      mesh_devices=0, mesh_min_rows=1,
+                      breaker=cbatch.CircuitBreaker(failure_threshold=3,
+                                                    cooldown=60.0))
+plane_h.start()
+groups_h = new_groups(THR)
+verd_h = drive(plane_h, groups_h)
+plane_h.stop()
+incidents.poke()  # anchor the storm window
+incidents.poke()  # evaluate it
+assert verd_h == exp_verdicts, "memo break must not change verdicts"
+steady_recompiles = \
+    deviceledger.counters()["steady_compiles"] - steady_before
+assert steady_recompiles >= 1, "broken memo never recompiled?"
+comp_recs = [r for r in deviceledger.ledger().records()
+             if r["seq"] > watermark and r["steady"]
+             and r["site"] == "plane.flush"]
+assert comp_recs, deviceledger.ledger().records()[-8:]
+# the flush that paid: the compile record's flush_seq joins the flush
+# ledger's comp_ms column (and the sharded flush measured util/dev_ms)
+recs_h = {r["seq"]: r for r in plane_h.dump_flushes()["flushes"]}
+paid = recs_h[comp_recs[0]["flush_seq"]]
+assert paid["comp_ms"] > 0, paid
+shard_h = [r for r in recs_h.values() if r["path"] == "fused_sharded"]
+assert shard_h and all(r["util"] > 0 for r in shard_h), shard_h
+assert all(r["dev_ms"] >= 0 for r in shard_h)
+storm_snaps = [s for s in incidents.recorder().incidents()
+               if s["trigger"] == "compile_storm"]
+assert storm_snaps, incidents.recorder().incidents()
+assert any("STEADY" in ln for ln in storm_snaps[0]["device_tail"]), \
+    storm_snaps[0]["device_tail"]
+incidents.install(old_rec)
+
 print(json.dumps({
     "ok": True,
     "devices": len(jax.devices()),
@@ -626,5 +686,11 @@ print(json.dumps({
         "rotation_window_ok": True,
         "drain_first_ok": True,
         "mid_deck_fallbacks": len(fb_g),
+    },
+    "observatory": {
+        "steady_recompiles": steady_recompiles,
+        "storm_fired": len(storm_snaps),
+        "paid_flush_comp_ms": paid["comp_ms"],
+        "sharded_util": shard_h[0]["util"],
     },
 }))

@@ -36,14 +36,14 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 8  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 9  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
     results = json.loads(out_path.read_text())["results"]
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
                                "cfg12_smoke", "cfg13_smoke",
-                               "cfg14_smoke",
+                               "cfg14_smoke", "cfg15_smoke",
                                "cfg2_smoke", "cfg4_smoke",
                                "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
@@ -77,6 +77,13 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     pd = results["cfg14_smoke"]["extra"]["peer_path"]
     assert 0 < pd["send_us_per_msg"] < 10.0
     assert 0 < pd["recv_us_per_msg"] < 10.0
+    # the cfg15 miniature proved the device observatory: compile
+    # attribution, the compile_storm trigger, residency math, and the
+    # per-flush hook budget
+    dv = results["cfg15_smoke"]["extra"]
+    assert dv["storm_fired"] == "compile_storm"
+    assert dv["compiles"] == 64
+    assert 0 < dv["flush_hooks"]["flush_hook_us_per_flush"] < 10.0
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

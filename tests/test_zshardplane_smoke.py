@@ -61,3 +61,13 @@ def test_sharded_plane_matches_single_device_on_forced_4dev_host():
     assert deck["rotation_window_ok"] is True  # staging-slot safety
     assert deck["drain_first_ok"] is True
     assert deck["mid_deck_fallbacks"] == 2
+    # ISSUE 15: the device observatory caught a deliberately broken
+    # mesh-step memo — steady-state recompiles recorded and attributed
+    # to the flush that paid (comp_ms), the compile_storm incident
+    # fired with the compile tail, and the sharded flushes measured a
+    # real rows-x-cost utilization
+    obs = rep["observatory"]
+    assert obs["steady_recompiles"] >= 1
+    assert obs["storm_fired"] >= 1
+    assert obs["paid_flush_comp_ms"] > 0
+    assert 0 < obs["sharded_util"] <= 1.0

@@ -36,6 +36,14 @@ _CFG_ORDER = re.compile(r"cfg(\d+)")
 # round that recorded it (the cfg10–13 precedent)
 _COMMIT_LATENCY_CFGS = ("cfg9", "cfg13")
 
+# configs that embed the device observatory's figures: cfg15 gets a
+# "cfg15 device" sub-row (cold compiles / steady recompiles — steady
+# must stay 0, the round-5 class), and the mesh configs get a "util"
+# sub-row from the rows-x-cost utilization model (extra.util_big /
+# extra.util_est.p50) — all-'—' before their first recorded round
+_DEVICE_CFGS = ("cfg15",)
+_UTIL_CFGS = {"cfg11": "util_big", "cfg12": "util_est"}
+
 
 def _cfg_key(name: str):
     if name == "headline":
@@ -84,6 +92,35 @@ def history(rounds: dict) -> dict:
                 "vs_baseline": res.get("vs_baseline") if res else None,
             })
         series[cfg] = pts
+        if cfg in _DEVICE_CFGS:
+            dpts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                cold = extra.get("cold_compiles")
+                steady = extra.get("steady_compiles")
+                dpts.append({
+                    "round": tag,
+                    "value": (f"{cold}c/{steady}s"
+                              if cold is not None and steady is not None
+                              else None),
+                    "unit": "cold/steady compiles",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} device"] = dpts
+        if cfg in _UTIL_CFGS:
+            upts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                u = extra.get(_UTIL_CFGS[cfg])
+                if isinstance(u, dict):  # cfg12 embeds the pcts block
+                    u = u.get("p50")
+                upts.append({
+                    "round": tag,
+                    "value": (f"{u:g}" if u is not None else None),
+                    "unit": "util p50",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} util"] = upts
         if cfg in _COMMIT_LATENCY_CFGS:
             cpts = []
             for tag in rounds:
