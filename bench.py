@@ -2561,6 +2561,127 @@ def cfg15_device(n_vals=1024, steady_reps=5):
         deviceledger.install(old_led)
 
 
+def _controller_closed_loop(n_cycles, peak_evals, trough_evals):
+    """Shared cfg16 driver: a real host-path VerifyPlane + real
+    AdmissionController as ACTUATORS, a synthetic commit-latency
+    sensor as the pressure input, cycled peak -> trough. Returns
+    (wall_ms, evals, ctl_dump, checks)."""
+    from cometbft_tpu.libs import controller as controlplane
+    from cometbft_tpu.mempool.admission import AdmissionController
+    from cometbft_tpu.verifyplane.plane import VerifyPlane
+
+    class _Sensor:
+        p99 = 0.0
+
+        def __len__(self):
+            return 1
+
+        def summary(self):
+            return {"commit_latency_ms": {"p99": self.p99}}
+
+    fill = {"v": 0.1}
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    adm = AdmissionController(high_watermark=0.9, low_watermark=0.7,
+                              fill_fn=lambda: fill["v"])
+    sensor = _Sensor()
+    ctl = controlplane.Controller(slo_commit_p99_ms=100.0,
+                                  decision_interval=1, cooldown=0)
+    try:
+        ctl.attach(plane=plane, admission=adm, height_ledger=sensor,
+                   bounds={
+                       controlplane.ACT_BULK_WINDOW: (1.0, 8.0),
+                       controlplane.ACT_GATEWAY_WINDOW: (0.5, 4.0),
+                       controlplane.ACT_ADMISSION: (0.3, 0.9),
+                   })
+        consensus_window = plane.window
+        base_bulk = plane.bulk_window
+        height, evals = 0, 0
+        t = _now_ms()
+        for _ in range(n_cycles):
+            sensor.p99, fill["v"] = 500.0, 0.8   # peak: 5x over SLO
+            for _ in range(peak_evals):
+                height += 1
+                ctl.poke(height, 0)
+            tightened = (plane.bulk_window > base_bulk
+                         and adm.high_watermark < 0.9)
+            sensor.p99, fill["v"] = 10.0, 0.1    # trough: headroom
+            for _ in range(trough_evals):
+                height += 1
+                ctl.poke(height, 0)
+            evals += peak_evals + trough_evals
+        wall_ms = _now_ms() - t
+        dump = ctl.dump()
+        checks = {
+            "tightened_at_peak": tightened,
+            "relaxed_to_base": (
+                abs(plane.bulk_window - base_bulk) < 1e-9
+                and adm.high_watermark == 0.9),
+            "consensus_untouched": plane.window == consensus_window,
+            "all_within_bounds": all(
+                a["min"] - 1e-9 <= d["new"] <= a["max"] + 1e-9
+                for d in dump["decisions"]
+                for a in (dump["actuators"][d["actuator"]],)),
+        }
+        return wall_ms, evals, dump, checks
+    finally:
+        controlplane.clear_global_controller(ctl)
+        plane.stop()
+
+
+def smoke_controller(n_cycles=3):
+    """cfg16's host-only miniature: the closed loop end to end with no
+    jax in the process — tighten BEFORE the static config would shed
+    (windows widen, watermark drops on the pressure latch), relax back
+    to the configured base at the trough, clamp bounds honored on
+    every decision, the CONSENSUS lane untouched by construction, and
+    the decision dump embedded so tools/controller_report.py reads
+    this --json-out file directly."""
+    wall_ms, evals, dump, checks = _controller_closed_loop(
+        n_cycles, peak_evals=8, trough_evals=16)
+    assert all(checks.values()), checks
+    assert dump["state"]["decisions_total"] >= 2 * n_cycles
+    return {
+        "metric": "cfg16_smoke closed-loop controller",
+        "value": round(wall_ms, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {
+            "evals": evals,
+            "decisions_total": dump["state"]["decisions_total"],
+            "checks": checks,
+            "controller_dump": dump,
+        },
+    }
+
+
+def cfg16_controller(n_cycles=50):
+    """#16: the self-tuning control plane at sustained cadence. The
+    loop is host-side BY DESIGN (decisions ride consensus step
+    transitions; nothing in the decision path may touch the device),
+    so this config measures what production pays: per-eval overhead on
+    the step-transition seam across many peak/trough cycles, plus the
+    same closed-loop invariants as the smoke (tighten at peak, relax
+    to base, clamps, consensus untouched). The embedded dump is the
+    --diff input for tools/controller_report.py across rounds."""
+    wall_ms, evals, dump, checks = _controller_closed_loop(
+        n_cycles, peak_evals=8, trough_evals=16)
+    assert all(checks.values()), checks
+    dump["decisions"] = dump["decisions"][-64:]
+    return {
+        "metric": "cfg16 controller eval overhead",
+        "value": round(wall_ms * 1000.0 / max(1, evals), 3),
+        "unit": "us",
+        "vs_baseline": None,
+        "extra": {
+            "evals": evals,
+            "decisions_total": dump["state"]["decisions_total"],
+            "wall_ms": round(wall_ms, 3),
+            "checks": checks,
+            "controller_dump": dump,
+        },
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
@@ -2569,7 +2690,8 @@ SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg12_smoke", smoke_pipelined_deck),
                  ("cfg13_smoke", smoke_churn_warmer),
                  ("cfg14_smoke", smoke_peer_ledger),
-                 ("cfg15_smoke", smoke_device_observatory)]
+                 ("cfg15_smoke", smoke_device_observatory),
+                 ("cfg16_smoke", smoke_controller)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -2584,7 +2706,7 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg9", cfg9_sustained), ("cfg10", cfg10_gateway),
                 ("cfg11", cfg11_sharded_tally),
                 ("cfg12", cfg12_pipelined), ("cfg13", cfg13_churn),
-                ("cfg15", cfg15_device)]
+                ("cfg15", cfg15_device), ("cfg16", cfg16_controller)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 

@@ -120,6 +120,7 @@ def build_node(home: str, cfg=None):
         verify_plane=cfg.verify_plane,
         mempool_config=cfg.mempool,
         lightgate=cfg.lightgate,
+        controller=cfg.controller,
         p2p=True,
         node_key=NodeKey.load_or_gen(os.path.join(cfgdir, "node_key.json")),
         blocksync=cfg.base.blocksync,

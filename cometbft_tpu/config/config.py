@@ -204,6 +204,12 @@ class VerifyPlaneConfig:
     # the half's 65536-slot/device budget takes it); a flush past the
     # cap takes the full mesh and drains the deck first.
     pipeline_flights: int = 1
+    # controller headroom: the self-tuning loop ([controller]) may
+    # grow the deck up to this ceiling at runtime (0 = no headroom,
+    # the deck stays at pipeline_flights). The staging pool and mesh
+    # halves are sized for the CEILING at construction, and the
+    # table_cache_shard_tables cross-check below applies to it.
+    pipeline_flights_max: int = 0
     half_mesh_rows: int = 0
     # Next-epoch table warmer (verifyplane/warmer.py): when the block
     # executor applies validator updates, a background thread builds
@@ -233,6 +239,7 @@ class VerifyPlaneConfig:
             mesh_devices=self.mesh_devices if self.mesh else None,
             mesh_min_rows=self.mesh_min_rows,
             pipeline_flights=self.pipeline_flights,
+            pipeline_flights_max=self.pipeline_flights_max or None,
             half_mesh_rows=self.half_mesh_rows,
         )
 
@@ -273,6 +280,93 @@ class LightGateConfig:
             coalesce_timeout=self.coalesce_timeout,
             max_batch_headers=self.max_batch_headers,
         )
+
+
+@dataclass
+class ControllerConfig:
+    """The closed-loop self-tuning control plane (libs/controller).
+    Off by default: `enable = true` mounts it on the node, poked from
+    the consensus-step and dispatcher-drain seams. The SLO knobs are
+    the operator's declaration; everything else is loop mechanics with
+    safe defaults. Every actuator the loop may move carries explicit
+    clamp bounds here (validated against the static sections), so a
+    runaway loop degrades to the static config, never past it."""
+
+    enable: bool = False
+    # the operator-declared SLOs: commit p99 (the height ledger's
+    # apply-latency percentile) and the per-lane wait targets (these
+    # double as widen ceilings — a coalescing window IS added latency
+    # on its lane, so the controller never widens past half the target)
+    slo_commit_p99_ms: float = 500.0
+    slo_gateway_wait_ms: float = 250.0
+    slo_bulk_wait_ms: float = 1000.0
+    # loop mechanics: pokes per evaluation, per-actuator cooldown (in
+    # evaluations), the hysteresis exit threshold (pressure enters at
+    # SLO violation / fill_high, exits only below pressure_low AND
+    # fill_low — the PR-7 admission-hysteresis template)
+    decision_interval: int = 8
+    cooldown: int = 4
+    pressure_low: float = 0.5
+    fill_high: float = 0.6
+    fill_low: float = 0.3
+    # per-move step sizes (multiplicative for windows/deadline,
+    # additive for watermarks)
+    window_step: float = 1.5
+    watermark_step: float = 0.08
+    deadline_step: float = 0.75
+    util_low: float = 0.5
+    # actuator clamp bounds (satellite hardening): the window maxima,
+    # the deadline floor (must cover at least one flush window — a
+    # deadline under the window sheds EVERYTHING), and the admission
+    # floor (the high watermark may never be tightened below it)
+    bulk_window_max_ms: float = 24.0
+    gateway_window_max_ms: float = 12.0
+    bulk_deadline_min_ms: float = 50.0
+    admission_floor: float = 0.2
+
+    def build(self):
+        """A Controller per this config, or None when disabled."""
+        if not self.enable:
+            return None
+        from cometbft_tpu.libs.controller import Controller
+
+        return Controller(
+            slo_commit_p99_ms=self.slo_commit_p99_ms,
+            slo_gateway_wait_ms=self.slo_gateway_wait_ms,
+            slo_bulk_wait_ms=self.slo_bulk_wait_ms,
+            decision_interval=self.decision_interval,
+            cooldown=self.cooldown,
+            pressure_low=self.pressure_low,
+            fill_high=self.fill_high,
+            fill_low=self.fill_low,
+            window_step=self.window_step,
+            watermark_step=self.watermark_step,
+            deadline_step=self.deadline_step,
+            util_low=self.util_low,
+        )
+
+    def bounds(self, verify_plane: "VerifyPlaneConfig",
+               mempool: "MempoolConfig") -> dict:
+        """Actuator name -> (min, max) clamps, anchored at the static
+        sections' effective bases (the values the loop relaxes back
+        to and may never cross)."""
+        bulk_base = verify_plane.bulk_window_ms \
+            or 4 * verify_plane.window_ms
+        gw_base = verify_plane.gateway_window_ms \
+            or 2 * verify_plane.window_ms
+        return {
+            "bulk_window_ms": (
+                bulk_base, max(bulk_base, self.bulk_window_max_ms)),
+            "gateway_window_ms": (
+                gw_base, max(gw_base, self.gateway_window_max_ms)),
+            "bulk_deadline_ms": (
+                min(self.bulk_deadline_min_ms,
+                    verify_plane.bulk_deadline_ms),
+                verify_plane.bulk_deadline_ms),
+            "admission_high_watermark": (
+                min(self.admission_floor, mempool.high_watermark),
+                mempool.high_watermark),
+        }
 
 
 @dataclass
@@ -366,6 +460,8 @@ class Config:
     verify_plane: VerifyPlaneConfig = field(
         default_factory=VerifyPlaneConfig)
     lightgate: LightGateConfig = field(default_factory=LightGateConfig)
+    controller: ControllerConfig = field(
+        default_factory=ControllerConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     incidents: IncidentsConfig = field(default_factory=IncidentsConfig)
     failpoints: FailpointsConfig = field(default_factory=FailpointsConfig)
@@ -391,11 +487,25 @@ class Config:
                     f"[crypto] {name} must be >= 2 — capacity 1 would "
                     f"let a next-epoch warm insert evict the LIVE "
                     f"epoch's table mid-flush")
-        if self.verify_plane.pipeline_flights > 1 \
+        if self.verify_plane.pipeline_flights_max < 0:
+            raise ConfigError(
+                "[verify_plane] pipeline_flights_max must be >= 0 "
+                "(0 = no controller headroom)")
+        if self.verify_plane.pipeline_flights_max and \
+                self.verify_plane.pipeline_flights_max \
+                < self.verify_plane.pipeline_flights:
+            raise ConfigError(
+                "[verify_plane] pipeline_flights_max must be >= "
+                "pipeline_flights (it is the controller's grow "
+                "ceiling, not a second starting value)")
+        flights_ceiling = max(self.verify_plane.pipeline_flights,
+                              self.verify_plane.pipeline_flights_max)
+        if flights_ceiling > 1 \
                 and self.crypto.table_cache_shard_tables < 4:
             raise ConfigError(
                 "[crypto] table_cache_shard_tables must be >= 4 with "
-                "[verify_plane] pipeline_flights > 1 — the deck keeps "
+                "[verify_plane] pipeline_flights (or the controller "
+                "ceiling pipeline_flights_max) > 1 — the deck keeps "
                 "a LIVE sharded table per mesh half (two), so a "
                 "next-epoch warm of both halves needs headroom or it "
                 "evicts a live half's table mid-flush")
@@ -444,6 +554,58 @@ class Config:
                 "[mempool] low_watermark must be in [0, high_watermark]")
         if mp.retry_after_ms < 0:
             raise ConfigError("[mempool] retry_after_ms must be >= 0")
+        ctl = self.controller
+        for name in ("slo_commit_p99_ms", "slo_gateway_wait_ms",
+                     "slo_bulk_wait_ms"):
+            if getattr(ctl, name) <= 0:
+                raise ConfigError(f"[controller] {name} must be > 0")
+        if ctl.decision_interval < 1:
+            raise ConfigError(
+                "[controller] decision_interval must be >= 1")
+        if ctl.cooldown < 0:
+            raise ConfigError("[controller] cooldown must be >= 0")
+        if not 0.0 < ctl.pressure_low < 1.0:
+            raise ConfigError(
+                "[controller] pressure_low must be in (0, 1) — it is "
+                "the hysteresis EXIT threshold under the SLO")
+        if not 0.0 < ctl.fill_low < ctl.fill_high <= 1.0:
+            raise ConfigError(
+                "[controller] fill thresholds must satisfy "
+                "0 < fill_low < fill_high <= 1 (enter high, exit low "
+                "— equal thresholds flap at one boundary)")
+        if ctl.window_step <= 1.0:
+            raise ConfigError(
+                "[controller] window_step must be > 1 "
+                "(a multiplicative widen factor)")
+        if not 0.0 < ctl.deadline_step < 1.0:
+            raise ConfigError(
+                "[controller] deadline_step must be in (0, 1) "
+                "(a multiplicative tighten factor)")
+        if ctl.watermark_step <= 0:
+            raise ConfigError(
+                "[controller] watermark_step must be > 0")
+        if not 0.0 < ctl.util_low <= 1.0:
+            raise ConfigError(
+                "[controller] util_low must be in (0, 1]")
+        # actuator clamp hardening: the bounds a runaway loop degrades
+        # to must themselves be sane against the STATIC sections
+        if ctl.bulk_deadline_min_ms < self.verify_plane.window_ms:
+            raise ConfigError(
+                "[controller] bulk_deadline_min_ms must be >= "
+                "[verify_plane] window_ms — a shed deadline under one "
+                "flush window sheds every BULK submission before a "
+                "flush can reach it")
+        if not 0.0 < ctl.admission_floor <= 1.0:
+            raise ConfigError(
+                "[controller] admission_floor must be in (0, 1]")
+        if ctl.admission_floor > mp.high_watermark:
+            raise ConfigError(
+                "[controller] admission_floor must be <= [mempool] "
+                "high_watermark (the floor is a tighten LIMIT, not a "
+                "second watermark)")
+        for name in ("bulk_window_max_ms", "gateway_window_max_ms"):
+            if getattr(ctl, name) <= 0:
+                raise ConfigError(f"[controller] {name} must be > 0")
         if self.tracing.buffer < 16:
             raise ConfigError("[tracing] buffer must be >= 16 events")
         inc = self.incidents
@@ -487,6 +649,7 @@ def _render(cfg: Config) -> str:
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
         ("lightgate", cfg.lightgate),
+        ("controller", cfg.controller),
         ("tracing", cfg.tracing), ("incidents", cfg.incidents),
         ("failpoints", cfg.failpoints),
     ]:
@@ -511,6 +674,7 @@ def load_config(path: str) -> Config:
         ("mempool", cfg.mempool), ("consensus", cfg.consensus),
         ("crypto", cfg.crypto), ("verify_plane", cfg.verify_plane),
         ("lightgate", cfg.lightgate),
+        ("controller", cfg.controller),
         ("tracing", cfg.tracing), ("incidents", cfg.incidents),
         ("failpoints", cfg.failpoints),
     ]:

@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from cometbft_tpu.consensus import heightledger
 from cometbft_tpu.consensus import wal as walmod
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
+from cometbft_tpu.libs import controller as controlplane
 from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs import incidents
 from cometbft_tpu.libs import tracing
@@ -279,6 +280,10 @@ class ConsensusState(BaseService):
         if self.wal is not None:
             self.height_ledger.note_wal_fsync_base(self.wal.fsync_led_ns)
         incidents.poke(self.height, self.round)
+        # self-tuning seam: the controller shares the incident
+        # recorder's deterministic poke site (a counter bump when no
+        # controller is mounted; count-based evaluation when one is)
+        controlplane.poke(self.height, self.round)
         tracing.instant(
             "consensus.step", cat="consensus", height=self.height,
             round=self.round, step=STEP_NAMES.get(step, str(step)),

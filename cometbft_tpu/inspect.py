@@ -48,6 +48,7 @@ class _InspectNode:
         self.mempool = None
         self.app_conns = None
         self.metrics = None
+        self.controller = None
 
     def broadcast_tx(self, tx: bytes):
         raise RPCError(-32601, "inspect server is read-only")

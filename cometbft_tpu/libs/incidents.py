@@ -375,6 +375,7 @@ class IncidentRecorder:
             "height_tail": [],
             "peer_tail": [],
             "device_tail": [],
+            "controller_tail": [],
             "trace_tail": tracing.tail(24),
             "counters": self._counters(),
             "fingerprint": self._fingerprint,
@@ -406,6 +407,15 @@ class IncidentRecorder:
                 # the compile tail names WHICH sites/flushes paid the
                 # recompiles a compile_storm fired on
                 snap["device_tail"] = dl.ledger_tail(8)
+            except Exception:  # noqa: BLE001
+                pass
+        ctl = sys.modules.get("cometbft_tpu.libs.controller")
+        if ctl is not None:
+            try:
+                # a controller move inside the incident's window rides
+                # the snapshot: did the loop react before the trigger,
+                # and in which direction?
+                snap["controller_tail"] = ctl.controller_tail(8)
             except Exception:  # noqa: BLE001
                 pass
         return snap

@@ -471,7 +471,10 @@ class NodeMetrics:
         self.warmer_builds = r.counter(
             "verifyplane", "valset_warmer_builds_total",
             "Next-epoch table warmer build outcomes "
-            "(outcome=ok|failed|skipped|superseded)")
+            "(outcome=ok|failed|skipped|superseded; "
+            "outcome=incremental sub-counts the ok-builds satisfied "
+            "by patching a cached table's delta rows instead of a "
+            "full build)")
         self.warmer_hits = r.counter(
             "verifyplane", "valset_warmer_hits_total",
             "Table lookups answered by a warmer-prebuilt table (the "
@@ -529,6 +532,26 @@ class NodeMetrics:
             "device", "compile_ledger_records",
             "Compile events currently held by the bounded compile "
             "ledger ring")
+        # self-tuning control plane (libs/controller.py): decision
+        # counters + live actuator positions, sampled at scrape time
+        # from the registered controller (same _GLOBAL/_LAST caveat as
+        # the plane: the ledger belongs to the last node that mounted
+        # one)
+        self.controller_decisions = r.counter(
+            "controller", "decisions_total",
+            "Actuator moves committed by the self-tuning control "
+            "plane, labeled actuator + direction (up widens/relaxes, "
+            "down tightens/shrinks)")
+        self.controller_value = r.gauge(
+            "controller", "actuator_value",
+            "Current value of each controller-movable actuator "
+            "(window/deadline actuators in ms, admission watermark as "
+            "a fraction, pipeline_flights as a count)")
+        self.controller_slo_violation = r.counter(
+            "controller", "slo_violation_seconds_total",
+            "Cumulative seconds the height-ledger commit p99 spent "
+            "above the declared [controller] SLO, accrued between "
+            "controller evaluations")
 
     def _sample(self) -> None:
         """Scrape-time refresh of the push-less internals. Modules that
@@ -640,10 +663,11 @@ class NodeMetrics:
             w = wm and wm.last_warmer()
             if w is not None:
                 st = w.stats()
-                for outcome in ("ok", "failed", "skipped"):
+                for outcome in ("ok", "failed", "skipped",
+                                "incremental"):
                     self.warmer_builds._set(
                         (("outcome", outcome),),
-                        float(st["builds_" + outcome]))
+                        float(st.get("builds_" + outcome, 0)))
                 self.warmer_builds._set(
                     (("outcome", "superseded"),),
                     float(st["superseded"]))
@@ -772,6 +796,24 @@ class NodeMetrics:
                 self.p2p_dup_votes._set((), float(s["dup_votes"]))
                 for peer, rtt in led.rtt_rows():
                     self.p2p_ping_rtt.set(float(rtt), peer=peer)
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            # self-tuning control plane (module-loaded-only like the
+            # plane: decisions belong to whichever node mounted the
+            # controller last; _LAST keeps a stopped node's totals
+            # scrapeable)
+            cm = sys.modules.get("cometbft_tpu.libs.controller")
+            ctl = cm and (cm._GLOBAL or cm._LAST)
+            if ctl is not None:
+                for (act, direction), n in ctl.decision_counts.items():
+                    self.controller_decisions._set(
+                        (("actuator", act), ("direction", direction)),
+                        float(n))
+                for act, v in ctl.actuator_values().items():
+                    self.controller_value.set(float(v), actuator=act)
+                self.controller_slo_violation._set(
+                    (), float(ctl.slo_violation_s))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
 

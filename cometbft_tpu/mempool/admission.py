@@ -110,6 +110,22 @@ class AdmissionController:
             if self._inflight > 0:
                 self._inflight -= 1
 
+    # -- controller actuator (libs/controller) -----------------------------
+
+    def set_watermarks(self, high: float, low: float) -> tuple:
+        """Retune the fill watermarks live (the self-tuning control
+        plane tightens them under CONSENSUS pressure and relaxes them
+        back). Both move under the lock the gate reads them under, and
+        the low <= high invariant is preserved unconditionally — a bad
+        caller degrades to a coherent gate, never an inverted one.
+        The saturation latch is left alone: the next try_acquire
+        re-evaluates it against the new marks."""
+        with self._lock:
+            self.high_watermark = min(1.0, max(0.01, float(high)))
+            self.low_watermark = min(max(0.0, float(low)),
+                                     self.high_watermark)
+            return (self.high_watermark, self.low_watermark)
+
     # -- observability -----------------------------------------------------
 
     @property

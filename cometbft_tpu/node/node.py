@@ -43,6 +43,7 @@ class Node(BaseService):
         verify_plane=None,
         mempool_config=None,
         lightgate=None,
+        controller=None,
     ):
         """statesync_light_client: a light.Client already trusting a root
         header; providing it turns on the statesync->blocksync->consensus
@@ -254,6 +255,26 @@ class Node(BaseService):
             else:
                 self.lightgate = lightgate
 
+        # self-tuning control plane (config [controller];
+        # cometbft_tpu.libs.controller): accepts a ControllerConfig, a
+        # ready Controller, or None. The loop only ever moves sheddable
+        # actuators (BULK/GATEWAY windows, admission watermarks, the
+        # flight deck) — CONSENSUS lane bounds are structurally out of
+        # its reach. Attached + registered in on_start, after the plane.
+        self.controller = None
+        self._controller_bounds = None
+        if controller is not None:
+            if hasattr(controller, "build"):
+                self.controller = controller.build()
+                if self.controller is not None \
+                        and hasattr(verify_plane, "build"):
+                    # config-validated clamp bounds, anchored at the
+                    # static sections this node was actually built from
+                    self._controller_bounds = controller.bounds(
+                        verify_plane, mcfg)
+            else:
+                self.controller = controller
+
         # optional real p2p stack (node/node.go:443-447 createTransport/
         # createSwitch); when absent, `broadcast` (in-memory hub) rules
         self.switch = None
@@ -414,6 +435,19 @@ class Node(BaseService):
             # after the plane: the gateway's batch_fn rides its GATEWAY
             # lane from the first request
             self.lightgate.start()
+        if self.controller is not None:
+            # after the plane: attach() snapshots the live actuator
+            # bases (window/deadline/flights as configured) and the
+            # pokes only start deciding once registered global
+            from cometbft_tpu.libs import controller as controlplane
+
+            self.controller.attach(
+                plane=self.verify_plane,
+                admission=self.mempool.admission,
+                height_ledger=self.consensus.height_ledger,
+                bounds=self._controller_bounds,
+            )
+            controlplane.set_global_controller(self.controller)
         self.pruner.start()
         if self.switch is not None:
             self.switch.start()
@@ -481,6 +515,12 @@ class Node(BaseService):
         from cometbft_tpu.libs import incidents
 
         incidents.recorder().stop_watchdog()
+        if self.controller is not None:
+            # before the plane stops: no actuator moves may race the
+            # drain. _LAST keeps serving /dump_controller post-stop.
+            from cometbft_tpu.libs import controller as controlplane
+
+            controlplane.clear_global_controller(self.controller)
         if self.lightgate is not None:
             # before the plane stops: in-flight gateway verifies fall
             # back to the direct host path instead of racing the drain

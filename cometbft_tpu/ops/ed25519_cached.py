@@ -464,6 +464,49 @@ def _memo_cache_key(pub_bytes, powers) -> bytes:
     return key
 
 
+def _find_incremental_base(target, padded: int):
+    """Newest cached table with the same padded size and at most
+    MAX_INCREMENTAL changed slots, plus the changed indices — or None.
+    Callers hold _TABLE_LOCK. The delta compares FULL pubkey bytes —
+    a digest here would make cache reuse collidable (round-5 advisory
+    high)."""
+    for cand in reversed(list(_TABLE_CACHE.values())):
+        if cand.n_vals != padded or cand.pubs_host is None:
+            continue
+        diff = [i for i in range(padded)
+                if cand.pubs_host[i] != target[i]]
+        if len(diff) <= MAX_INCREMENTAL:
+            return cand, diff
+    return None
+
+
+def _patch_from_base(cand: ValsetTable, diff, target, powers,
+                     padded: int) -> Optional[ValsetTable]:
+    """Patch `cand`'s delta rows into the target valset's table
+    (update_table runs the SAME per-slot program build_table would, so
+    the result is byte-identical to a cold full build). Returns None
+    when the delta overflows update_table's slot budget — callers pay
+    the full rebuild. Only CHANGED powers ride the update (the full
+    map crashed update_table's slot budget for valsets > 128 and
+    rewrote every power row). powers=None means ZERO powers — same as
+    a cold build_table(pubs, None) — so tally semantics never depend
+    on whether the lookup hit the near-miss cache (round-5 advisory
+    low)."""
+    changes = [(int(i), target[i]) for i in diff]
+    new_ph = _powers_host(powers, padded)
+    old_ph = (cand.powers_host if cand.powers_host is not None
+              else np.zeros((padded,), np.int64))
+    pw_map = {int(i): int(new_ph[i])
+              for i in np.nonzero(new_ph != old_ph)[0]}
+    try:
+        t = update_table(cand, changes, pw_map)
+    except ValueError:
+        return None  # delta too large: full rebuild on the caller
+    with _TABLE_LOCK:
+        _TABLE_STATS["incremental_patches"] += 1
+    return t
+
+
 def table_for_pubs_info(pub_bytes: Sequence[bytes],
                         powers=None) -> Tuple[ValsetTable, bool]:
     """(table, warm): warm=True when the lookup was a straight LRU hit
@@ -479,44 +522,46 @@ def table_for_pubs_info(pub_bytes: Sequence[bytes],
             return t, True
         _TABLE_STATS["misses"] += 1
         # near-miss scan: same padded size, few changed slots -> update
-        # the cached table incrementally (valset churn between epochs).
-        # The delta compares FULL pubkey bytes — a digest here would
-        # make cache reuse collidable (round-5 advisory high).
-        base = None
+        # the cached table incrementally (valset churn between epochs)
         padded = table_pad(len(pub_bytes))
         target = _pubs_host(pub_bytes, padded)
-        for cand in reversed(list(_TABLE_CACHE.values())):
-            if cand.n_vals != padded or cand.pubs_host is None:
-                continue
-            diff = [i for i in range(padded)
-                    if cand.pubs_host[i] != target[i]]
-            if len(diff) <= MAX_INCREMENTAL:
-                base = (cand, diff)
-                break
+        base = _find_incremental_base(target, padded)
     t = None
     if base is not None:
         cand, diff = base
-        changes = [(int(i), target[i]) for i in diff]
-        # only CHANGED powers ride the update (the full map crashed
-        # update_table's slot budget for valsets > 128 and rewrote
-        # every power row). powers=None means ZERO powers — same as a
-        # cold build_table(pubs, None) — so tally semantics never
-        # depend on whether the lookup hit the near-miss cache
-        # (round-5 advisory low).
-        new_ph = _powers_host(powers, padded)
-        old_ph = (cand.powers_host if cand.powers_host is not None
-                  else np.zeros((padded,), np.int64))
-        pw_map = {int(i): int(new_ph[i])
-                  for i in np.nonzero(new_ph != old_ph)[0]}
-        try:
-            t = update_table(cand, changes, pw_map)
-        except ValueError:
-            t = None  # delta too large: full rebuild below
+        t = _patch_from_base(cand, diff, target, powers, padded)
     if t is None:
         t = build_table(pub_bytes, powers)
     with _TABLE_LOCK:
         _TABLE_CACHE.put(key, t)
     return t, False
+
+
+def warm_incremental(pub_bytes: Sequence[bytes], powers=None) -> bool:
+    """The warmer's incremental fast path: when a cached near-miss
+    table covers the change set (<= MAX_INCREMENTAL slots), patch its
+    delta rows into the cache instead of paying the full next-epoch
+    build — byte-identical to the cold build by update_table's
+    construction. Returns True when the target table is now cached
+    (already present, or patched in here); False means no eligible
+    base exists and the caller decides whether to pay the full build.
+    Counts neither a hit nor a miss: this is a warm, not a lookup."""
+    key = _memo_cache_key(pub_bytes, powers)
+    with _TABLE_LOCK:
+        if _TABLE_CACHE.get(key) is not None:
+            return True
+        padded = table_pad(len(pub_bytes))
+        target = _pubs_host(pub_bytes, padded)
+        base = _find_incremental_base(target, padded)
+    if base is None:
+        return False
+    cand, diff = base
+    t = _patch_from_base(cand, diff, target, powers, padded)
+    if t is None:
+        return False
+    with _TABLE_LOCK:
+        _TABLE_CACHE.put(key, t)
+    return True
 
 
 def table_for_pubs(pub_bytes: Sequence[bytes],

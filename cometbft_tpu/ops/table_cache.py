@@ -59,7 +59,7 @@ STATS = {"hits": 0, "misses": 0, "key_memo_hits": 0,
          "shard_hits": 0, "shard_misses": 0,
          "evictions_tables": 0, "evictions_shard": 0,
          "evictions_valset_memo": 0, "evictions_key_memo": 0,
-         "warmed_hits": 0}
+         "warmed_hits": 0, "incremental_patches": 0}
 
 
 def default_size(value) -> int:

@@ -634,6 +634,21 @@ class Routes:
 
         return deviceledger.dump_devices()
 
+    def dump_controller(self):
+        """The self-tuning control plane's decision ledger
+        (libs/controller.py): every actuator move with its trigger
+        sensor readings, the current/base/clamp value of every
+        actuator, and the SLO + loop state (also served as GET
+        /dump_controller). Prefers this node's mounted controller;
+        falls back to the module global/_LAST so post-mortem reads
+        work after the node stopped."""
+        from cometbft_tpu.libs import controller as controlplane
+
+        ctl = getattr(self.node, "controller", None)
+        if ctl is not None:
+            return ctl.dump()
+        return controlplane.dump_controller()
+
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
 
@@ -723,7 +738,7 @@ _ROUTES = [
     "broadcast_tx_sync", "broadcast_tx_async", "broadcast_tx_commit",
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes", "dump_heights",
-    "dump_incidents", "dump_peers", "dump_devices",
+    "dump_incidents", "dump_peers", "dump_devices", "dump_controller",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -844,7 +859,8 @@ class _Handler(BaseHTTPRequestHandler):
         # the always-on flush/height ledgers, incident snapshots
         if url.path in ("/dump_traces", "/dump_flushes",
                         "/dump_heights", "/dump_incidents",
-                        "/dump_peers", "/dump_devices"):
+                        "/dump_peers", "/dump_devices",
+                        "/dump_controller"):
             self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):

@@ -32,7 +32,8 @@ class SimnetFailure(AssertionError):
                  include_ledger: bool = True,
                  include_heights: bool = True,
                  include_incidents: bool = True,
-                 include_peers: bool = True):
+                 include_peers: bool = True,
+                 include_controller: bool = True):
         self.seed = seed
         self.schedule = schedule
         text = msg
@@ -71,6 +72,16 @@ class SimnetFailure(AssertionError):
         p_tail = peerledger.ledger_tail(8) if include_peers else []
         if p_tail:
             text += "\npeer ledger tail: " + " | ".join(p_tail)
+        # the self-tuning control plane's decision tail: what the loop
+        # moved (and in which direction) before the run failed — the
+        # decisions are count-based on deterministic poke sites, so the
+        # tail in a replayed blob matches the original byte for byte
+        from cometbft_tpu.libs import controller as controlplane
+
+        c_tail = controlplane.controller_tail(8) \
+            if include_controller else []
+        if c_tail:
+            text += "\ncontroller decisions: " + " | ".join(c_tail)
         # incidents frozen DURING this simulation (commit stalls, round
         # escalations, ...) are first-class replay evidence
         inc_tail = incidents.incident_tail(4) if include_incidents \
@@ -107,6 +118,7 @@ class Simnet:
         # THIS simulation
         from cometbft_tpu import verifyplane
         from cometbft_tpu.consensus import heightledger
+        from cometbft_tpu.libs import controller as controlplane
         from cometbft_tpu.libs import incidents
         from cometbft_tpu.p2p import peerledger
 
@@ -114,6 +126,7 @@ class Simnet:
         self._height_mark = heightledger.ledger_mark()
         self._incident_mark = incidents.incident_mark()
         self._peer_mark = peerledger.ledger_mark()
+        self._controller_mark = controlplane.controller_mark()
 
     # -- running -----------------------------------------------------------
 
@@ -224,6 +237,8 @@ class Simnet:
             self._launch_flood(op)
         elif kind == "epoch":
             self._launch_epoch(op)
+        elif kind == "controller":
+            self._launch_controller(op)
 
     # flood txs are signed with ONE deterministic throwaway key (a
     # function of nothing but this constant), so the same (seed,
@@ -270,6 +285,43 @@ class Simnet:
             tx = sigtx.wrap(priv, payload) if signed else payload
             net.schedule(k / rate, lambda k=k, tx=tx: inject(k, tx),
                          f"flood n{idx}")
+
+    def _launch_controller(self, op: Dict) -> None:
+        """Mount the self-tuning control plane on the target node:
+        attached to that node's admission gate + height ledger (and
+        the process-global verify plane, when a scenario started one),
+        registered as THE module-global controller so the consensus-
+        step pokes start deciding. Decisions are count-based on
+        deterministic poke sites (the dispatcher-drain seam only ever
+        moves the flight deck, whose grow signal needs fused device
+        flushes no simnet plane produces), so the decision stream is a
+        pure function of (seed, schedule)."""
+        import sys
+
+        from cometbft_tpu.libs import controller as controlplane
+
+        net = self.net
+        snode = net.nodes[int(op["node"])]
+        if not snode.alive:
+            return
+        kwargs = {k: v for k, v in op.items()
+                  if k not in ("at", "op", "node", "bounds")}
+        ctl = controlplane.Controller(**kwargs)
+        vp = sys.modules.get("cometbft_tpu.verifyplane.plane")
+        plane = vp._GLOBAL if vp is not None else None
+        # JSON bounds arrive as {actuator: [lo, hi]} — without them
+        # every actuator clamps to (base, base) and the mounted loop
+        # observes but never moves
+        bounds = {name: (float(b[0]), float(b[1]))
+                  for name, b in (op.get("bounds") or {}).items()}
+        ctl.attach(
+            plane=plane,
+            admission=snode.node.mempool.admission,
+            height_ledger=snode.node.consensus.height_ledger,
+            bounds=bounds,
+        )
+        controlplane.set_global_controller(ctl)
+        snode.node.controller = ctl
 
     def _launch_epoch(self, op: Dict) -> None:
         """One epoch of proportional committee re-election over the
@@ -416,6 +468,7 @@ class Simnet:
     def _fail(self, msg: str) -> "SimnetFailure":
         from cometbft_tpu import verifyplane
         from cometbft_tpu.consensus import heightledger
+        from cometbft_tpu.libs import controller as controlplane
         from cometbft_tpu.libs import incidents
         from cometbft_tpu.p2p import peerledger
 
@@ -427,6 +480,8 @@ class Simnet:
             include_incidents=incidents.incident_advanced(
                 self._incident_mark),
             include_peers=peerledger.ledger_advanced(self._peer_mark),
+            include_controller=controlplane.controller_advanced(
+                self._controller_mark),
         )
 
     def commit_hashes(self) -> List[Dict[int, bytes]]:

@@ -65,6 +65,18 @@ Op catalog (each op is a plain dict, `at` in simulated seconds):
       so CheckTx signature verification exercises the verify plane's
       BULK lane. Every CheckTx response is recorded on the harness
       (Simnet.flood_results) so overload verdicts are assertable.
+  {"at": t, "op": "controller", "node": i, "slo_commit_p99_ms": ms,
+   "decision_interval": k, "cooldown": c,
+   "bounds": {actuator: [lo, hi]}, ...}
+      Mount the self-tuning control plane (libs/controller.Controller)
+      on node i: attached to that node's admission gate + height
+      ledger and the process-global verify plane, registered as THE
+      module-global controller so the consensus-step / dispatcher-
+      drain pokes start deciding. Any Controller constructor kwarg may
+      ride in the op. Decisions are count-based on deterministic poke
+      sites, so the /dump_controller decision stream replays
+      byte-identically for the same (seed, schedule); the decision
+      tail rides every SimnetFailure replay blob.
 """
 from __future__ import annotations
 
@@ -73,7 +85,7 @@ from typing import Dict, List
 
 OPS = ("partition", "heal", "link", "kill", "restart", "failpoint",
        "equivocate", "garbage", "light_attack", "gateway_sync", "tx",
-       "flood", "epoch")
+       "flood", "epoch", "controller")
 
 _LINK_KEYS = ("drop", "delay", "jitter", "dup", "reorder")
 
@@ -112,9 +124,28 @@ def validate_schedule(schedule: List[Dict], n_nodes: int) -> None:
         # (a replay-blob failure instead of this loud ScheduleError)
         if kind in ("kill", "restart", "failpoint", "equivocate",
                     "garbage", "tx", "flood", "gateway_sync",
-                    "epoch") \
+                    "epoch", "controller") \
                 and "node" not in op:
             raise ScheduleError(f"{kind} requires a node in {op!r}")
+        if kind == "controller":
+            for key in ("slo_commit_p99_ms", "slo_gateway_wait_ms",
+                        "slo_bulk_wait_ms"):
+                if key in op and float(op[key]) <= 0:
+                    raise ScheduleError(
+                        f"controller {key} must be > 0 in {op!r}")
+            if int(op.get("decision_interval", 1)) < 1:
+                raise ScheduleError(
+                    f"controller decision_interval must be >= 1 "
+                    f"in {op!r}")
+            if int(op.get("cooldown", 0)) < 0:
+                raise ScheduleError(
+                    f"controller cooldown must be >= 0 in {op!r}")
+            for name, b in (op.get("bounds") or {}).items():
+                if (not isinstance(b, (list, tuple)) or len(b) != 2
+                        or float(b[0]) > float(b[1])):
+                    raise ScheduleError(
+                        f"controller bounds[{name!r}] must be a "
+                        f"[lo, hi] pair with lo <= hi in {op!r}")
         if kind == "epoch":
             churn = float(op.get("churn", 0.25))
             if not 0.0 < churn <= 1.0:

@@ -84,6 +84,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from cometbft_tpu.libs import controller as controlplane
 from cometbft_tpu.libs import deviceledger
 from cometbft_tpu.libs import failpoints as fp
 from cometbft_tpu.libs import tracing
@@ -591,6 +592,7 @@ class VerifyPlane:
                  mesh_devices: Optional[int] = None,
                  mesh_min_rows: int = 256,
                  pipeline_flights: int = 1,
+                 pipeline_flights_max: Optional[int] = None,
                  half_mesh_rows: int = 0):
         from cometbft_tpu.crypto import batch as cbatch
         from cometbft_tpu.libs.staging import StagingPool
@@ -680,6 +682,13 @@ class VerifyPlane:
         # disjoint halves (resolved with the mesh). half_mesh_rows is
         # the policy knob: a flush over it takes the full mesh.
         self.flights = max(1, int(pipeline_flights))
+        # controller ceiling: the deck may GROW to flights_max at
+        # runtime (libs/controller), so everything sized at
+        # construction (staging pool, mesh halves) must be sized for
+        # the ceiling, not the starting value — a live grow must never
+        # alias staging buffers
+        self.flights_max = max(self.flights,
+                               int(pipeline_flights_max or 0))
         self.half_mesh_rows = max(0, int(half_mesh_rows))
         self._halves: list = []    # resolved with the mesh
         self.deck_airborne = 0     # flights airborne right now
@@ -701,8 +710,10 @@ class VerifyPlane:
         # Depth tracks the deck: up to `flights` flushes pin their
         # buffers under airborne flights while the next one packs, so
         # flights+1 slots keep pack(k+2) off flight k's memory (the
-        # old hardcoded 2 silently aliased the third pack's buffers)
-        self._staging = StagingPool(slots=self.flights + 1)
+        # old hardcoded 2 silently aliased the third pack's buffers).
+        # Sized at the CEILING: the controller may grow flights live,
+        # and the pool depth cannot change under airborne flights.
+        self._staging = StagingPool(slots=self.flights_max + 1)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -934,6 +945,10 @@ class VerifyPlane:
         buffer."""
         deck: List[_Flight] = []  # airborne flights, dispatch order
         while True:
+            # self-tuning seam: one controller poke per drain cycle,
+            # OUTSIDE the cv (the controller may call actuator setters
+            # that take it). No-op when no controller is mounted.
+            controlplane.poke_drain()
             batch: List[_Submission] = []
             shed: List[_Submission] = []
             depth = 0
@@ -1340,10 +1355,12 @@ class VerifyPlane:
                 self._mesh = None
             self.mesh_ndev = (0 if self._mesh is None
                               else int(self._mesh.devices.size))
-            if self.flights > 1 and self._mesh is not None:
+            if self.flights_max > 1 and self._mesh is not None:
                 # the deck's disjoint halves ride the same memoized
                 # sub-mesh seam effective_mesh clamps through; meshes
-                # under 4 devices have none (single-flight dispatch)
+                # under 4 devices have none (single-flight dispatch).
+                # Gated on the CEILING, not the live value: the
+                # controller may grow flights after the mesh resolved
                 self._halves = fz.half_meshes(self._mesh)
             # published LAST: the warmer's _mesh_targets reads
             # (_mesh_resolved, _mesh, _halves) from its own thread —
@@ -1585,6 +1602,60 @@ class VerifyPlane:
             "tids": tids,
         })
 
+    # -- controller actuators (libs/controller) ----------------------------
+    # Clamped live setters over the knobs the dispatcher already
+    # re-reads every drain cycle (lane_window / lane_deadline /
+    # flights) — no dispatcher restart, no queue disturbance. The
+    # CONSENSUS lane is structurally off-limits: its window and bounds
+    # have no setter path, and the lane is rejected outright, so no
+    # control loop can ever create a path that sheds CONSENSUS.
+
+    def set_lane_window_ms(self, lane: str, ms: float) -> float:
+        """Retune a SHEDDABLE lane's coalescing window. Returns the
+        applied value (ms)."""
+        if lane not in SHEDDABLE_LANES:
+            raise ValueError(
+                f"lane {lane!r} window is not controller-adjustable "
+                f"(CONSENSUS bounds are structurally off-limits)")
+        w = max(0.0, float(ms)) / 1000.0
+        with self._cv:
+            self.lane_window[lane] = w
+            if lane == LANE_BULK:
+                self.bulk_window = w
+            else:
+                self.gateway_window = w
+            self._cv.notify_all()
+        return w * 1000.0
+
+    def set_lane_deadline_ms(self, lane: str, ms: float) -> float:
+        """Retune a SHEDDABLE lane's shed deadline. A lane configured
+        with deadline 0 (shedding disabled) stays disabled — enabling
+        shedding is an operator decision, not a controller move."""
+        if lane not in self.lane_deadline:
+            raise ValueError(
+                f"lane {lane!r} has no shed deadline (CONSENSUS is "
+                f"never shed)")
+        d = max(0.0, float(ms)) / 1000.0
+        with self._cv:
+            if not self.lane_deadline[lane]:
+                return 0.0
+            self.lane_deadline[lane] = d
+            if lane == LANE_BULK:
+                self.bulk_deadline = d
+            else:
+                self.gateway_deadline = d
+        return d * 1000.0
+
+    def set_flights(self, n: int) -> int:
+        """Grow/shrink the flight deck within [1, flights_max]. The
+        staging pool and mesh halves were sized for flights_max at
+        construction, so a live grow never aliases staging buffers;
+        a shrink drains excess airborne flights on the next cycle."""
+        with self._cv:
+            self.flights = min(self.flights_max, max(1, int(n)))
+            self._cv.notify_all()
+            return self.flights
+
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:
@@ -1610,6 +1681,7 @@ class VerifyPlane:
             "shard_flushes": self.shard_flushes,
             "shard_rows": self.shard_rows,
             "flights": self.flights,
+            "flights_max": self.flights_max,
             "halves": len(self._halves),
             "deck_airborne": self.deck_airborne,
             "deck_peak": self.deck_peak,

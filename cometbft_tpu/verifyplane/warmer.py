@@ -83,10 +83,15 @@ class TableWarmer:
         self._building = False
         self._thread: Optional[threading.Thread] = None
         self._running = False
-        # accounting (sampled into /metrics at scrape time)
+        # accounting (sampled into /metrics at scrape time).
+        # builds_incremental counts the ok-builds the cache satisfied
+        # by patching a near-miss table's delta rows (ed25519_cached
+        # update_table) instead of the full next-epoch build — the
+        # epoch-churn fast path; always <= builds_ok.
         self.builds_ok = 0
         self.builds_failed = 0
         self.builds_skipped = 0
+        self.builds_incremental = 0
         self.superseded = 0
         self.last_build_ms = 0.0
 
@@ -241,9 +246,19 @@ class TableWarmer:
         with tcache.LOCK:
             present = key in tcache.TABLES
         if not present:
+            # the lookup itself prefers the incremental path: a small
+            # change set patches a cached near-miss table's delta rows
+            # (update_table) instead of the full build. The stat delta
+            # attributes it — this warm was an epoch-churn patch, not
+            # a from-scratch table program.
+            with tcache.LOCK:
+                inc0 = tcache.STATS["incremental_patches"]
             _, hit = ec.table_for_pubs_info(pubs, powers)
             if not hit:
                 ec.note_warmed(key)
+                with tcache.LOCK:
+                    if tcache.STATS["incremental_patches"] > inc0:
+                        self.builds_incremental += 1
         meshes = self._mesh_targets(len(pubs))
         if meshes:
             from cometbft_tpu.parallel import mesh as pm
@@ -322,6 +337,7 @@ class TableWarmer:
             "builds_ok": self.builds_ok,
             "builds_failed": self.builds_failed,
             "builds_skipped": self.builds_skipped,
+            "builds_incremental": self.builds_incremental,
             "superseded": self.superseded,
             "last_build_ms": self.last_build_ms,
         }

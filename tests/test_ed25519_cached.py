@@ -271,3 +271,31 @@ def test_near_miss_large_valset_power_delta():
              for i in range(130)]
     t3 = ec.table_for_pubs(pubs3, powers)
     assert t3 is not t2 and t3.n_vals == 256
+
+
+def test_warm_incremental_byte_identical_to_cold_build():
+    """The warmer's incremental patch must be indistinguishable from
+    the full next-epoch build: every device/host array of the patched
+    table equals the cold build_table result byte-for-byte."""
+    pubs, _, _ = make_sigs(8, msg_fn=lambda i: b"wi-%d" % i)
+    powers = list(range(1, 9))
+    ec.table_for_pubs(pubs, powers)  # the base epoch's table
+    s = b"\xcf" * 32
+    pubs2 = list(pubs)
+    pubs2[3] = ed.pubkey_from_seed(s)
+    powers2 = list(powers)
+    powers2[3] = 77
+    key2 = tuple(pubs2)
+    assert ec.warm_incremental(key2, powers2) is True
+    patched = ec.table_for_pubs(key2, powers2)  # plain LRU hit now
+    cold = ec.build_table(pubs2, powers2)
+    assert patched is not cold
+    np.testing.assert_array_equal(np.asarray(patched.tab),
+                                  np.asarray(cold.tab))
+    np.testing.assert_array_equal(np.asarray(patched.ok),
+                                  np.asarray(cold.ok))
+    np.testing.assert_array_equal(np.asarray(patched.power5),
+                                  np.asarray(cold.power5))
+    assert patched.pubs_host == cold.pubs_host
+    np.testing.assert_array_equal(patched.powers_host,
+                                  cold.powers_host)

@@ -97,3 +97,85 @@ def test_pad_rows_buckets():
     assert ec.pad_rows(10000) == 10240
     with pytest.raises(ValueError):
         ec.pad_rows(70000)
+
+
+# -- incremental warming (warm_incremental) --------------------------------
+# Each test swaps in a private table cache so the shared process-global
+# one (other test files may have populated it) can never donate or
+# receive a near-miss base.
+
+def _fake_table(pubs, padded=128):
+    return ec.ValsetTable(None, None, None, padded,
+                          ec._pubs_host(pubs, padded),
+                          np.zeros(padded, np.int64))
+
+
+def _private_cache(monkeypatch):
+    from cometbft_tpu.ops import table_cache as tc
+
+    cache = tc.BoundedLRU("tables", 8, size_fn=tc.default_size)
+    monkeypatch.setattr(ec, "_TABLE_CACHE", cache)
+    return cache
+
+
+def test_warm_incremental_no_base_returns_false(monkeypatch):
+    cache = _private_cache(monkeypatch)
+    calls = []
+    monkeypatch.setattr(ec, "update_table",
+                        lambda *a, **k: calls.append(a))
+    assert ec.warm_incremental(tuple(pubs_n(4, tag=101))) is False
+    assert calls == [] and len(cache) == 0
+    # a base of a DIFFERENT padded size is not eligible either
+    with ec._TABLE_LOCK:
+        cache.put(b"base256", _fake_table(pubs_n(200, tag=102), 256))
+    assert ec.warm_incremental(tuple(pubs_n(4, tag=101))) is False
+    assert calls == []
+
+
+def test_warm_incremental_patches_eligible_base(monkeypatch):
+    cache = _private_cache(monkeypatch)
+    base_pubs = pubs_n(4, tag=103)
+    target_pubs = tuple(pubs_n(4, tag=104))
+    with ec._TABLE_LOCK:
+        cache.put(b"base", _fake_table(base_pubs))
+        h0 = dict(ec._TABLE_STATS)
+    marker = _fake_table(target_pubs)
+    seen = []
+
+    def fake_update(cand, changes, pw_map=None):
+        seen.append((len(changes), dict(pw_map or {})))
+        return marker
+
+    monkeypatch.setattr(ec, "update_table", fake_update)
+    assert ec.warm_incremental(target_pubs) is True
+    # the 4 changed slots (padding rows identical) rode the update,
+    # with no power rewrites
+    assert seen == [(4, {})]
+    key = ec._memo_cache_key(target_pubs, None)
+    with ec._TABLE_LOCK:
+        assert cache.peek(key) is marker
+        h1 = dict(ec._TABLE_STATS)
+    # a warm is neither a hit nor a miss, but IS an incremental patch
+    assert h1["hits"] == h0["hits"]
+    assert h1["misses"] == h0["misses"]
+    assert h1["incremental_patches"] == h0["incremental_patches"] + 1
+    # second warm: already cached, no second update_table call
+    assert ec.warm_incremental(target_pubs) is True
+    assert len(seen) == 1
+
+
+def test_warm_incremental_budget_overflow_returns_false(monkeypatch):
+    cache = _private_cache(monkeypatch)
+    with ec._TABLE_LOCK:
+        cache.put(b"base", _fake_table(pubs_n(4, tag=105)))
+
+    def refuse(*a, **k):
+        raise ValueError("delta over budget")
+
+    monkeypatch.setattr(ec, "update_table", refuse)
+    with ec._TABLE_LOCK:
+        h0 = dict(ec._TABLE_STATS)
+    assert ec.warm_incremental(tuple(pubs_n(4, tag=106))) is False
+    with ec._TABLE_LOCK:
+        h1 = dict(ec._TABLE_STATS)
+    assert h1["incremental_patches"] == h0["incremental_patches"]

@@ -36,7 +36,7 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 9  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 10  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
@@ -44,8 +44,8 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
                                "cfg12_smoke", "cfg13_smoke",
                                "cfg14_smoke", "cfg15_smoke",
-                               "cfg2_smoke", "cfg4_smoke",
-                               "cfg6_smoke"]
+                               "cfg16_smoke", "cfg2_smoke",
+                               "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -84,6 +84,13 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert dv["storm_fired"] == "compile_storm"
     assert dv["compiles"] == 64
     assert 0 < dv["flush_hooks"]["flush_hook_us_per_flush"] < 10.0
+    # the cfg16 miniature proved the closed loop: tighten at peak,
+    # relax to base at the trough, clamps honored, consensus untouched
+    # — and embedded the dump tools/controller_report.py reads
+    ct = results["cfg16_smoke"]["extra"]
+    assert all(ct["checks"].values()), ct["checks"]
+    assert ct["decisions_total"] >= 6
+    assert ct["controller_dump"]["decisions"], ct["controller_dump"]
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

@@ -44,6 +44,12 @@ _COMMIT_LATENCY_CFGS = ("cfg9", "cfg13")
 _DEVICE_CFGS = ("cfg15",)
 _UTIL_CFGS = {"cfg11": "util_big", "cfg12": "util_est"}
 
+# cfg16 embeds the closed-loop controller dump: a "cfg16 loop" sub-row
+# tracks decisions-per-round and accrued SLO-violation seconds (the
+# loop's one job is keeping the latter at 0) — '—' before its first
+# recorded round, same as the device/commit sub-rows
+_CONTROLLER_CFGS = ("cfg16",)
+
 
 def _cfg_key(name: str):
     if name == "headline":
@@ -121,6 +127,23 @@ def history(rounds: dict) -> dict:
                     "vs_baseline": None,
                 })
             series[f"{cfg} util"] = upts
+        if cfg in _CONTROLLER_CFGS:
+            lpts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                n = extra.get("decisions_total")
+                st = (extra.get("controller_dump") or {}).get(
+                    "state") or {}
+                viol = st.get("slo_violation_s")
+                lpts.append({
+                    "round": tag,
+                    "value": (f"{n}d/{viol:g}s"
+                              if n is not None and viol is not None
+                              else None),
+                    "unit": "decisions/violation",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} loop"] = lpts
         if cfg in _COMMIT_LATENCY_CFGS:
             cpts = []
             for tag in rounds:
