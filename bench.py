@@ -2682,6 +2682,126 @@ def cfg16_controller(n_cycles=50):
     }
 
 
+def _tenant_pod(k_chains, rounds, rows_per_sub):
+    """Shared cfg17 driver: the SAME K-chain ed25519 verify workload
+    run two ways — K chains sharing ONE multi-tenant plane (per-round
+    submissions from every chain coalesce into fused flushes with
+    per-tenant ledger attribution) vs one plane per chain (the
+    pod-per-chain status quo this subsystem replaces). Returns
+    (shared_ms, split_ms, checks, figures)."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.verifyplane.plane import LANE_BULK, VerifyPlane
+
+    chains = [f"bench-{i}" for i in range(k_chains)]
+    rows = {}
+    for i, chain in enumerate(chains):
+        msg = b"cfg17:" + chain.encode()
+        rows[chain] = []
+        for j in range(rows_per_sub):
+            priv = PrivKey.generate(bytes([200 + i, j]) + b"\x33" * 30)
+            rows[chain].append((priv.pub_key(), msg, priv.sign(msg)))
+
+    def drive(plane_of):
+        verdicts = []
+        t = _now_ms()
+        for _ in range(rounds):
+            futs = [plane_of(c).submit_many(
+                        list(rows[c]), lane=LANE_BULK, chain_id=c)
+                    for c in chains]
+            verdicts.append(tuple(f.result(30.0) for f in futs))
+        return _now_ms() - t, verdicts
+
+    shared = VerifyPlane(window_ms=0.5, use_device=False)
+    shared.start()
+    try:
+        shared_ms, v_shared = drive(lambda c: shared)
+        summary = shared.ledger.summary()
+        recs = shared.ledger.records()
+        dump = shared.tenants.dump()
+        flushes_shared = len(recs)
+    finally:
+        shared.stop()
+
+    split = {c: VerifyPlane(window_ms=0.5, use_device=False)
+             for c in chains}
+    for p in split.values():
+        p.start()
+    try:
+        split_ms, v_split = drive(lambda c: split[c])
+        flushes_split = sum(len(p.ledger.records())
+                            for p in split.values())
+    finally:
+        for p in split.values():
+            p.stop()
+
+    total_rows = k_chains * rounds * rows_per_sub
+    checks = {
+        # sharing the plane changes the economics, never the verdicts
+        "verdicts_identical": v_shared == v_split,
+        "all_verified": all(all(v) for r in v_shared for v in r),
+        # the ledger's per-tenant attribution sums to each flush total
+        "attribution_sums": all(
+            sum(n for _, n in r["tenants"]) == r["rows"]
+            for r in recs),
+        # the whole point: multi-chain rows landed in FUSED flushes
+        "coalesced": summary.get("coalesced_flushes", 0) >= 1,
+        "every_tenant_accounted": all(
+            dump["tenants"][c]["rows"] == rounds * rows_per_sub
+            for c in chains),
+    }
+    figures = {
+        "k_chains": k_chains,
+        "rows_total": total_rows,
+        "flushes_shared": flushes_shared,
+        "flushes_split": flushes_split,
+        "coalesced_flushes": summary.get("coalesced_flushes", 0),
+        "split_ms": round(split_ms, 3),
+        "speedup_vs_split": round(split_ms / max(shared_ms, 1e-9), 3),
+        "tenants_dump": dump,
+    }
+    return shared_ms, split_ms, checks, figures
+
+
+def smoke_tenants(k_chains=2, rounds=3, rows_per_sub=4):
+    """cfg17's host-only miniature: two chains on one plane with no
+    jax in the process — identical verdicts to the per-chain-plane
+    arm, fused cross-tenant flushes on the ledger, attribution sums
+    exact, and the tenants_dump embedded so tools/tenant_report.py
+    reads this --json-out file directly."""
+    shared_ms, _, checks, figures = _tenant_pod(
+        k_chains, rounds, rows_per_sub)
+    assert all(checks.values()), checks
+    return {
+        "metric": "cfg17_smoke multi-tenant pod",
+        "value": round(shared_ms, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": dict(figures, checks=checks),
+    }
+
+
+def cfg17_tenants(k_chains=8, rounds=12, rows_per_sub=16):
+    """#17: the multi-tenant verify plane at pod scale — K chains'
+    BULK verify traffic through ONE plane vs K per-chain planes over
+    the same signed rows. The shared arm's flush count collapses
+    (cross-tenant coalescing: one drain cycle serves many chains) and
+    its throughput is the headline figure; verdicts must match the
+    split arm bit-for-bit. The embedded tenants_dump is the --diff
+    input for tools/tenant_report.py across rounds."""
+    shared_ms, split_ms, checks, figures = _tenant_pod(
+        k_chains, rounds, rows_per_sub)
+    assert all(checks.values()), checks
+    total_rows = figures["rows_total"]
+    return {
+        "metric": "cfg17 shared-plane verify throughput",
+        "value": round(total_rows / max(shared_ms, 1e-9) * 1000.0, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "extra": dict(figures, checks=checks,
+                      shared_ms=round(shared_ms, 3)),
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
@@ -2691,7 +2811,8 @@ SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg13_smoke", smoke_churn_warmer),
                  ("cfg14_smoke", smoke_peer_ledger),
                  ("cfg15_smoke", smoke_device_observatory),
-                 ("cfg16_smoke", smoke_controller)]
+                 ("cfg16_smoke", smoke_controller),
+                 ("cfg17_smoke", smoke_tenants)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -2706,7 +2827,8 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg9", cfg9_sustained), ("cfg10", cfg10_gateway),
                 ("cfg11", cfg11_sharded_tally),
                 ("cfg12", cfg12_pipelined), ("cfg13", cfg13_churn),
-                ("cfg15", cfg15_device), ("cfg16", cfg16_controller)]
+                ("cfg15", cfg15_device), ("cfg16", cfg16_controller),
+                ("cfg17", cfg17_tenants)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 

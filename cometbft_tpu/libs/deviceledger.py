@@ -564,4 +564,15 @@ def dump_devices() -> dict:
             doc["flushes"] = plane.ledger.summary().get("device")
         except Exception:  # noqa: BLE001 - dump must never fault
             pass
+    # the tenant dimension of the same residency truth: the tenancy
+    # registry attributes the live caches' bytes per hosted chain
+    # (verifyplane/tenants.py, read-time walk — no double entry).
+    # Absent until the tenants module loads, like the flushes block.
+    vt = sys.modules.get("cometbft_tpu.verifyplane.tenants")
+    reg = vt and vt.last_registry()
+    if reg is not None:
+        try:
+            doc["residency_by_tenant"] = reg.residency_by_tenant()
+        except Exception:  # noqa: BLE001 - dump must never fault
+            pass
     return doc

@@ -552,6 +552,33 @@ class NodeMetrics:
             "Cumulative seconds the height-ledger commit p99 spent "
             "above the declared [controller] SLO, accrued between "
             "controller evaluations")
+        # multi-tenant verify plane (verifyplane/tenants.py): per-chain
+        # accounting sampled from the tenancy registry at scrape time.
+        # Cardinality discipline: tenant-labeled families carry only
+        # the top-K tenants by cumulative rows (the ping_rtt_ms bound)
+        # plus one tenant="_retired" series accumulating evicted
+        # tenants' totals so the family-wide sum stays monotone across
+        # registry eviction (the PR-14 drop-ring lesson)
+        self.tenant_rows = r.counter(
+            "verifyplane", "tenant_rows_total",
+            "Rows the verify plane served per tenant chain (label "
+            "tenant; top-K by cumulative rows + tenant=\"_retired\" "
+            "folding evicted tenants' totals)")
+        self.tenant_sheds = r.counter(
+            "verifyplane", "tenant_sheds_total",
+            "Explicit per-tenant sheds — quota refusals and lane "
+            "deadline/overload sheds attributed to the submitting "
+            "chain (label tenant; same top-K + _retired bound as "
+            "tenant_rows_total)")
+        self.tenant_registry_size = r.gauge(
+            "verifyplane", "tenant_registry_size",
+            "Chains currently registered with the verify plane's "
+            "tenancy registry")
+        self.tenant_resident = r.gauge(
+            "verifyplane", "tenant_resident_bytes",
+            "Bytes of cached valset tables attributed per tenant "
+            "chain through the registry's owner map (label tenant; "
+            "unowned tables fall to tenant=\"default\")")
 
     def _sample(self) -> None:
         """Scrape-time refresh of the push-less internals. Modules that
@@ -664,7 +691,7 @@ class NodeMetrics:
             if w is not None:
                 st = w.stats()
                 for outcome in ("ok", "failed", "skipped",
-                                "incremental"):
+                                "skipped_quota", "incremental"):
                     self.warmer_builds._set(
                         (("outcome", outcome),),
                         float(st.get("builds_" + outcome, 0)))
@@ -796,6 +823,34 @@ class NodeMetrics:
                 self.p2p_dup_votes._set((), float(s["dup_votes"]))
                 for peer, rtt in led.rtt_rows():
                     self.p2p_ping_rtt.set(float(rtt), peer=peer)
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            # multi-tenant verify plane (module-loaded-only like the
+            # plane: the registry belongs to the last plane that went
+            # global; _LAST keeps a stopped plane's tenants scrapeable)
+            vt = sys.modules.get("cometbft_tpu.verifyplane.tenants")
+            reg = vt and vt.last_registry()
+            if reg is not None:
+                mr = reg.metrics_rows()
+                for name, row in mr["top"].items():
+                    key = (("tenant", name),)
+                    self.tenant_rows._set(key, float(row["rows"]))
+                    self.tenant_sheds._set(key, float(row["sheds"]))
+                ret = mr["retired"]
+                self.tenant_rows._set((("tenant", "_retired"),),
+                                      float(ret["rows"]))
+                self.tenant_sheds._set((("tenant", "_retired"),),
+                                       float(ret["sheds"]))
+                self.tenant_registry_size.set(
+                    float(mr["registry_size"]))
+                # gauge: stale tenants must vanish, not freeze (the
+                # device_resident discipline)
+                with self.tenant_resident._lock:
+                    self.tenant_resident._values.clear()
+                for name, slot in reg.residency_by_tenant().items():
+                    self.tenant_resident.set(float(slot["bytes"]),
+                                             tenant=name)
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:

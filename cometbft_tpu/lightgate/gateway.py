@@ -77,7 +77,7 @@ class GatewayOverloaded(GatewayError):
         self.retry_after_ms = float(retry_after_ms)
 
 
-def gateway_batch_fn() -> Callable:
+def gateway_batch_fn(chain_id: Optional[str] = None) -> Callable:
     """batch_fn(pubs, msgs, sigs) -> (n,) bool riding the verify
     plane's GATEWAY lane when a plane runs. A PlaneOverloaded shed is
     re-raised as GatewayOverloaded (hint preserved) so it surfaces to
@@ -85,7 +85,9 @@ def gateway_batch_fn() -> Callable:
     fallback path. With no plane (or a plane stopping mid-call) rows
     verify on the inline per-row host reference path — exactly what a
     plane-less light client does, and jax-free so the gateway serves
-    on host-only nodes (tier-1 smoke) without touching a kernel."""
+    on host-only nodes (tier-1 smoke) without touching a kernel.
+    `chain_id` keys GATEWAY rows to their tenant so a shared plane
+    attributes (and quota-gates) them per hosted chain."""
 
     def fn(pubs, msgs, sigs):
         import numpy as np
@@ -96,7 +98,8 @@ def gateway_batch_fn() -> Callable:
         if p is not None:
             try:
                 return p.submit_and_wait(pubs, msgs, sigs,
-                                         lane=vp.LANE_GATEWAY)
+                                         lane=vp.LANE_GATEWAY,
+                                         chain_id=chain_id)
             except vp.PlaneOverloaded as e:
                 raise GatewayOverloaded(
                     str(e), retry_after_ms=e.retry_after_ms) from e
@@ -176,7 +179,7 @@ class LightGateway:
             chain_id, provider,
             trusting_period=self.trusting_period,
             batch_fn=batch_fn if batch_fn is not None
-            else gateway_batch_fn(),
+            else gateway_batch_fn(chain_id),
             store=store,
         )
         self.cache = VerifiedLRU(cache_size)

@@ -37,7 +37,8 @@ CACHE_SIZE = 10000  # config.mempool.cache_size default
 class Mempool:
     def __init__(self, app: abci.Application, max_txs: int = 5000,
                  cache_size: int = CACHE_SIZE, recheck: bool = True,
-                 verify_sigs: bool = True, admission=None, metrics=None):
+                 verify_sigs: bool = True, admission=None, metrics=None,
+                 chain_id: Optional[str] = None):
         self.app = app
         self.max_txs = max_txs
         self.cache_size = max(1, int(cache_size))
@@ -49,6 +50,9 @@ class Mempool:
         self.verify_sigs = bool(verify_sigs)
         self.admission = admission  # AdmissionController or None
         self.metrics = metrics
+        # tenant key for plane submissions (verifyplane/tenants.py):
+        # BULK rows attribute to the hosting chain, None = "default"
+        self.chain_id = chain_id
         self._txs: deque = deque()
         self._tx_set = set()
         self._tx_gas = {}  # tx -> gas_wanted from its CheckTx
@@ -105,7 +109,8 @@ class Mempool:
         if plane is not None:
             try:
                 fut = plane.submit(pub, msg, parsed.signature,
-                                   lane=vp.LANE_BULK, block=False)
+                                   lane=vp.LANE_BULK, block=False,
+                                   chain_id=self.chain_id)
                 ok = fut.result()[0]
             except vp.PlaneOverloaded as e:
                 return self._overloaded(

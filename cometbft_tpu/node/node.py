@@ -160,7 +160,7 @@ class Node(BaseService):
         self.mempool = Mempool(
             self.app_conns.mempool, max_txs=mcfg.size,
             cache_size=mcfg.cache_size, recheck=mcfg.recheck,
-            verify_sigs=mcfg.verify_sigs,
+            verify_sigs=mcfg.verify_sigs, chain_id=state.chain_id,
         )
         self.mempool.admission = mcfg.build_admission(
             fill_fn=self.mempool.fill_fraction,

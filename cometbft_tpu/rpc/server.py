@@ -649,6 +649,19 @@ class Routes:
             return ctl.dump()
         return controlplane.dump_controller()
 
+    def dump_tenants(self):
+        """The multi-tenant verify plane's tenancy registry
+        (verifyplane/tenants.py): registered chains with their
+        pending-row quotas and HBM residency budgets, per-tenant
+        rows/sheds per lane, warm skips, cold evictions, wait
+        percentiles, live residency attribution, and the retired
+        totals accumulator (also served as GET /dump_tenants). Serves
+        the LAST plane's registry after a stop, like every other dump
+        route."""
+        from cometbft_tpu.verifyplane import tenants as vtenants
+
+        return vtenants.dump_tenants()
+
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
 
@@ -739,6 +752,7 @@ _ROUTES = [
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes", "dump_heights",
     "dump_incidents", "dump_peers", "dump_devices", "dump_controller",
+    "dump_tenants",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -860,7 +874,7 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path in ("/dump_traces", "/dump_flushes",
                         "/dump_heights", "/dump_incidents",
                         "/dump_peers", "/dump_devices",
-                        "/dump_controller"):
+                        "/dump_controller", "/dump_tenants"):
             self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):

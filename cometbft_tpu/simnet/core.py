@@ -213,9 +213,12 @@ class SimNode:
     """
 
     def __init__(self, net: "SimNetwork", idx: int, app_factory, priv,
-                 home: str):
+                 home: str, group: int = 0):
         self.net = net
         self.idx = idx
+        # which chain group this node validates (multi-chain simnet:
+        # group g runs chain net.chain_ids[g]; meshes never cross)
+        self.group = group
         self.app_factory = app_factory
         self.priv = priv
         self.home = home
@@ -249,7 +252,7 @@ class SimNode:
 
         with self.net._node_scope(self):
             self.node = Node(
-                self.app_factory(), self.net.genesis.copy(),
+                self.app_factory(), self.net.geneses[self.group].copy(),
                 privval=FilePV(self.priv), home=self.home,
                 broadcast=self._broadcast, timeouts=self.net.timeouts,
             )
@@ -282,8 +285,11 @@ class SimNode:
         self.conns[conn.dst] = conn
 
     def connect_full_mesh(self) -> None:
+        """Full mesh WITHIN this node's chain group: independent chains
+        share the process (and the verify plane) but never a link."""
         for j, other in enumerate(self.net.nodes):
-            if j != self.idx and other.alive and j not in self.conns:
+            if j != self.idx and other.group == self.group \
+                    and other.alive and j not in self.conns:
                 self.transport.dial(j)
 
     def halt(self, reason: str) -> None:
@@ -415,7 +421,7 @@ class SimNode:
         if self.equivocate_budget > 0 and not vote.block_id.is_nil():
             self.equivocate_budget -= 1
             out.append(_vote_bytes(actors.conflicting_vote(
-                vote, self.priv, self.net.chain_id
+                vote, self.priv, self.net.chain_ids[self.group]
             )))
         return out
 
@@ -435,6 +441,16 @@ class SimNode:
 class SimNetwork:
     """The hub: event queue, links, clock, and N SimNodes.
 
+    Multi-chain hosting (`n_chains` > 1): the net carries K independent
+    chain groups of `n_nodes` validators each — per-group chain_id,
+    genesis, and keys; full mesh within a group, no links across — all
+    pumped by the ONE scheduler. The groups share the process, which
+    means they share a process-global verify plane when a test mounts
+    one: K chains' signature work coalescing into single fused flushes
+    is exactly the multi-tenant hosting story verifyplane/tenants.py
+    implements, and group g is key-identical to a solo net seeded
+    seed+g so its commits can be diffed against a solo run.
+
     Epoch-scale churn (`extra_validators` > 0): beyond the N running
     node-validators, the network carries a deterministic POOL of
     passive tail validators — pubkey-only members (hash-derived 32-byte
@@ -452,7 +468,8 @@ class SimNetwork:
     def __init__(self, n_nodes: int, seed: int, basedir: str,
                  app_factory=None, timeouts=None, chain_id: str = "simnet",
                  power: int = 10, extra_validators: int = 0,
-                 committee_size: Optional[int] = None):
+                 committee_size: Optional[int] = None,
+                 n_chains: int = 1):
         import hashlib
         import os
 
@@ -462,12 +479,28 @@ class SimNetwork:
         from cometbft_tpu.state.state import State
         from cometbft_tpu.types.validator import Validator, ValidatorSet
 
+        # multi-chain simnet (the appchain-hosting shape): K chain
+        # groups of n_nodes each, every group a fully independent chain
+        # — own chain_id, own genesis, own validator keys, links only
+        # within the group — all driven by ONE scheduler in ONE process,
+        # so a process-global verify plane coalesces their signature
+        # work exactly like a hosting pod would. n_nodes is PER CHAIN.
+        self.n_chains = max(1, int(n_chains))
+        self.n_per_chain = n_nodes
+        if self.n_chains > 1 and extra_validators:
+            raise ValueError(
+                "extra_validators (epoch churn) supports single-chain "
+                "simnets only — the tail pool and election state are "
+                "per-network, not per-group")
         self.seed = seed
         self.rng = random.Random(seed)
         self.now = 0.0
         self._seq = 0
         self.events: list = []  # heap of (time, seq, fn, label)
         self.chain_id = chain_id
+        self.chain_ids = ([chain_id] if self.n_chains == 1 else
+                          [f"{chain_id}-{g}"
+                           for g in range(self.n_chains)])
         # Sim seconds are free; REAL work per height (WAL fsyncs, sqlite
         # commits, host-path signature verifies) is not. The commit
         # timeout paces the chain relative to schedule windows — 0.25
@@ -480,14 +513,26 @@ class SimNetwork:
             precommit=0.5, precommit_delta=0.25,
             commit=0.25,
         )
+        # chain g's keys derive from (seed + g, local index): group g
+        # of a K-chain net is KEY-IDENTICAL to a solo single-chain net
+        # built with seed seed+g — which is what lets the coalescing
+        # acceptance compare a chain's commits on the shared plane
+        # against the same chain run alone, byte for byte. n_chains=1
+        # reduces to the original derivation exactly.
         self.privs = [
             PrivKey.generate(
-                (seed % 2**32).to_bytes(4, "big")  # seeds are arbitrary
-                + bytes([i + 1]) + b"\x51" * 27    # ints in replay blobs
+                (((seed + i // n_nodes) % 2**32)
+                 .to_bytes(4, "big"))              # seeds are arbitrary
+                + bytes([i % n_nodes + 1]) + b"\x51" * 27  # replay blobs
             )
-            for i in range(n_nodes)
+            for i in range(n_nodes * self.n_chains)
         ]
-        val_list = [Validator(p.pub_key(), power) for p in self.privs]
+        val_lists = [
+            [Validator(p.pub_key(), power)
+             for p in self.privs[g * n_nodes:(g + 1) * n_nodes]]
+            for g in range(self.n_chains)
+        ]
+        val_list = val_lists[0]
         # passive tail pool + proportional genesis committee (the
         # epoch-rotation surface; see the class docstring)
         self.tail_pubs: List[bytes] = []
@@ -533,22 +578,31 @@ class SimNetwork:
                           self.tail_stakes[i][1])
                 for i in committee
             ]
-        vals = ValidatorSet(val_list)
-        self.genesis = State.make_genesis(
-            chain_id, vals, genesis_time=Timestamp(SIM_EPOCH_SECONDS, 0),
-        )
+        self.geneses = [
+            State.make_genesis(
+                self.chain_ids[g], ValidatorSet(val_lists[g]),
+                genesis_time=Timestamp(SIM_EPOCH_SECONDS, 0),
+            )
+            for g in range(self.n_chains)
+        ]
+        self.genesis = self.geneses[0]  # single-chain callers' alias
+        total = n_nodes * self.n_chains
         app_factory = app_factory or KVStoreApplication
         self.nodes = [
             SimNode(self, i, app_factory, self.privs[i],
-                    os.path.join(basedir, f"n{i}"))
-            for i in range(n_nodes)
+                    os.path.join(basedir, f"n{i}"), group=i // n_nodes)
+            for i in range(total)
         ]
         self.links: Dict[Tuple[int, int], Link] = {
             (i, j): Link()
-            for i in range(n_nodes) for j in range(n_nodes) if i != j
+            for i in range(total) for j in range(total) if i != j
         }
         self.sync_interval = 0.5  # catch-up push cadence, sim seconds
         self._clock_installed = False
+
+    def group_nodes(self, g: int) -> List[SimNode]:
+        """The SimNodes validating chain group g (chain_ids[g])."""
+        return [n for n in self.nodes if n.group == g]
 
     # -- clock + scheduler -------------------------------------------------
 

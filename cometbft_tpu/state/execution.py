@@ -416,7 +416,8 @@ class BlockExecutor:
             # Cheap no-op when no warmer is registered (simnet, tests).
             from cometbft_tpu.verifyplane import warmer as vp_warmer
 
-            vp_warmer.notify_next_valset(next_vals)
+            vp_warmer.notify_next_valset(next_vals,
+                                         chain_id=state.chain_id)
         next_vals.increment_proposer_priority(1)
         return replace(
             state,

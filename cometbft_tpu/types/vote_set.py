@@ -240,7 +240,7 @@ class VoteSet:
         try:
             fut = plane.submit_many(rows, power=val.voting_power,
                                     group=group, counted=counted,
-                                    vidx=vidx)
+                                    vidx=vidx, chain_id=self.chain_id)
             verdicts = fut.result()
         except PlaneError:
             # plane stopped/saturated mid-call: serial host fallback

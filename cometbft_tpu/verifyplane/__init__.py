@@ -7,6 +7,7 @@ bucket batches, flushes on a micro-batch deadline or a full bucket, and
 fuses per-group voting-power tallies into the same pass.
 """
 from cometbft_tpu.verifyplane.plane import (
+    DEFAULT_TENANT,
     LANE_BULK,
     LANE_CONSENSUS,
     LANE_GATEWAY,
@@ -30,6 +31,13 @@ from cometbft_tpu.verifyplane.plane import (
     plane_batch_fn,
     set_global_plane,
 )
+from cometbft_tpu.verifyplane.tenants import (
+    TenantOverloaded,
+    TenantRegistry,
+    dump_tenants,
+    global_registry,
+    last_registry,
+)
 from cometbft_tpu.verifyplane.warmer import (
     TableWarmer,
     clear_global_warmer,
@@ -39,6 +47,7 @@ from cometbft_tpu.verifyplane.warmer import (
 )
 
 __all__ = [
+    "DEFAULT_TENANT",
     "LANE_BULK",
     "LANE_CONSENSUS",
     "LANE_GATEWAY",
@@ -51,6 +60,8 @@ __all__ = [
     "PlaneStopped",
     "QuorumGroup",
     "TableWarmer",
+    "TenantOverloaded",
+    "TenantRegistry",
     "VerifyFuture",
     "VerifyPlane",
     "clear_global_plane",
@@ -59,8 +70,11 @@ __all__ = [
     "notify_next_valset",
     "set_global_warmer",
     "dump_flushes",
+    "dump_tenants",
     "flush_stats_for_seqs",
     "global_plane",
+    "global_registry",
+    "last_registry",
     "ledger_advanced",
     "ledger_mark",
     "ledger_tail",

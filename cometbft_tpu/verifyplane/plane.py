@@ -113,6 +113,12 @@ LANES = (LANE_CONSENSUS, LANE_GATEWAY, LANE_BULK)
 # lanes that may be answered with an explicit Overloaded shed verdict
 # (CONSENSUS is never shed by construction)
 SHEDDABLE_LANES = (LANE_GATEWAY, LANE_BULK)
+# the tenant submissions fall to when no chain_id is given — a
+# single-chain node never needs to know the tenancy layer exists
+# (verifyplane/tenants.py owns the registry; the constant lives here
+# so the hot submit path and the registry share one spelling without
+# a circular import)
+DEFAULT_TENANT = "default"
 # anti-starvation: even a flush filled to max_batch with CONSENSUS rows
 # carries up to max_batch // BULK_QUANTUM_DIV extra rows PER lower
 # lane, so a sustained consensus storm degrades GATEWAY/BULK to a
@@ -159,11 +165,33 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
  _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_BRK, _L_SMISS,
  _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
  _L_NHOST, _L_DEV0, _L_WARM, _L_COMP, _L_H2D, _L_DEV,
- _L_UTIL) = range(26)
+ _L_UTIL, _L_TEN) = range(27)
 # internal slots past the FIELDS window: ns stamps + the clock
 # generation they were taken under + the first-ready probe stamp
 # (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 26, 27, 28, 29
+_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 27, 28, 29, 30
+
+
+def _tenant_rows(col) -> dict:
+    """Aggregate the ledger's per-flush tenant splits into {chain_id:
+    rows} over the window (summary/read time only)."""
+    out: dict = {}
+    for pairs in col:
+        for chain, rows in pairs:
+            out[chain] = out.get(chain, 0) + rows
+    return out
+
+
+def _tenant_split(batch) -> tuple:
+    """The ledger's per-tenant row attribution for one flush: sorted
+    ((chain_id, rows), ...) pairs summing to the flush total. A sorted
+    tuple of pairs, not a dict — the record is a flat list mutated in
+    place, and replay comparisons need a deterministic, hashable
+    value."""
+    d: dict = {}
+    for s in batch:
+        d[s.tenant] = d.get(s.tenant, 0) + len(s.rows)
+    return tuple(sorted(d.items()))
 
 
 def _device_block(cols: dict) -> dict:
@@ -227,7 +255,10 @@ class FlushLedger:
     / padded device slots staged (the rows-x-cost utilization of the
     pass; 0 on non-fused paths). comp_ms and h2d_ms decompose part
     of pack_ms (dispatch runs inside the pack span); dev_ms overlaps
-    flight+collect.
+    flight+collect. ``tenants`` is the multi-tenant row attribution:
+    sorted ((chain_id, rows), ...) pairs summing to the flush total —
+    the ledger evidence that ONE flush coalesced rows from MANY
+    chains (verifyplane/tenants.py; empty on shed-only cycles).
     Written by the dispatcher even when tracing is off; read by
     /dump_flushes, the scrape-time /metrics percentiles, and simnet
     replay blobs."""
@@ -237,7 +268,7 @@ class FlushLedger:
               "path", "breaker", "staging_miss", "depth",
               "c_rows", "g_rows", "b_rows", "shed", "n_dev",
               "n_host", "dev0", "warm", "comp_ms", "h2d_ms",
-              "dev_ms", "util")
+              "dev_ms", "util", "tenants")
 
     __slots__ = ("_ring",)
 
@@ -324,6 +355,12 @@ class FlushLedger:
                       LANE_GATEWAY: int(sum(cols["g_rows"])),
                       LANE_BULK: int(sum(cols["b_rows"]))},
             "shed": int(sum(cols["shed"])),
+            # multi-tenant attribution: per-chain rows over the window
+            # plus the coalescing evidence — flushes whose tenant
+            # split names >1 chain (one device pass, many chains)
+            "tenants": _tenant_rows(cols["tenants"]),
+            "coalesced_flushes": sum(
+                1 for t in cols["tenants"] if len(t) > 1),
             # cross-chip attribution: flushes/rows that rode the
             # sharded mesh pass, and the widest fan-out seen
             "shard": {
@@ -499,10 +536,10 @@ class QuorumGroup:
 class _Submission:
     __slots__ = ("rows", "future", "group", "power", "counted",
                  "vidx", "t_submit", "t_submit_led", "clock_gen", "tid",
-                 "lane")
+                 "lane", "tenant")
 
     def __init__(self, rows, group, power, counted, vidx=None,
-                 lane=LANE_CONSENSUS):
+                 lane=LANE_CONSENSUS, tenant=None):
         self.rows = rows                      # [(PubKey, msg, sig), ...]
         self.future = VerifyFuture()
         self.group = group
@@ -510,6 +547,11 @@ class _Submission:
         self.counted = bool(counted)
         self.vidx = tuple(vidx) if vidx is not None else None
         self.lane = lane
+        # tenancy key: which chain this work belongs to (DEFAULT_TENANT
+        # when the caller predates the multi-tenant plane) — drives the
+        # ledger's per-tenant attribution, the fair-share drain, and
+        # the quota gates (verifyplane/tenants.py)
+        self.tenant = tenant if tenant else DEFAULT_TENANT
         self.t_submit = time.perf_counter()
         # ledger/trace-clock stamp for queued_ms: rides the ledger
         # clock (== the trace clock when tracing is on; virtual under
@@ -593,7 +635,8 @@ class VerifyPlane:
                  mesh_min_rows: int = 256,
                  pipeline_flights: int = 1,
                  pipeline_flights_max: Optional[int] = None,
-                 half_mesh_rows: int = 0):
+                 half_mesh_rows: int = 0,
+                 tenants=None):
         from cometbft_tpu.crypto import batch as cbatch
         from cometbft_tpu.libs.staging import StagingPool
 
@@ -664,6 +707,21 @@ class VerifyPlane:
         self._shed_lock = threading.Lock()
         self.lane_waits = {lane: deque(maxlen=LANE_WAIT_WINDOW)
                            for lane in LANES}
+        # multi-tenant plane (verifyplane/tenants.py): the registry
+        # owning quotas, the fair-share rotation cursor, and the
+        # per-tenant accounting /dump_tenants serves. Injected for
+        # tests; every plane gets one — a single-chain node just never
+        # registers a second tenant. _pending_tenant_rows is the O(1)
+        # per-(lane, tenant) pending-row split the quota gate and the
+        # fair-share fast path read under _cv (a dict per lane:
+        # tenant -> rows, entries removed at zero so the common
+        # single-tenant case stays a one-key dict).
+        if tenants is None:
+            from cometbft_tpu.verifyplane.tenants import TenantRegistry
+
+            tenants = TenantRegistry()
+        self.tenants = tenants
+        self._pending_tenant_rows: dict = {lane: {} for lane in LANES}
         # multichip sharded dispatch ([verify_plane] mesh knobs):
         # mesh_devices None = single-device; 0 = shard fused flushes
         # over ALL local devices; N = cap at N. mesh_min_rows keeps
@@ -759,6 +817,7 @@ class VerifyPlane:
                 while q:
                     leftovers.append(q.popleft())
                 self._pending_rows[lane] = 0
+                self._pending_tenant_rows[lane].clear()
         budget = STOP_DRAIN_MAX_ROWS
         settle, fail = [], []
         for sub in leftovers:
@@ -789,7 +848,7 @@ class VerifyPlane:
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
                 0, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
-                1, 0, 0, 0.0, 0.0, 0.0, 0.0,
+                1, 0, 0, 0.0, 0.0, 0.0, 0.0, _tenant_split(settle),
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -809,14 +868,14 @@ class VerifyPlane:
     def submit(self, pub, msg: bytes, sig: bytes, power: int = 0,
                group: Optional[QuorumGroup] = None, counted: bool = False,
                vidx: Optional[int] = None,
-               block: bool = True, lane: str = LANE_CONSENSUS
-               ) -> VerifyFuture:
+               block: bool = True, lane: str = LANE_CONSENSUS,
+               chain_id: Optional[str] = None) -> VerifyFuture:
         """Submit one (pubkey, msg, sig); the future resolves to a
         1-tuple verdict."""
         return self.submit_many(
             [(pub, msg, sig)], power=power, group=group, counted=counted,
             vidx=None if vidx is None else (vidx,), block=block,
-            lane=lane,
+            lane=lane, chain_id=chain_id,
         )
 
     def submit_many(self, rows, power: int = 0,
@@ -824,7 +883,8 @@ class VerifyPlane:
                     counted: bool = False,
                     vidx: Optional[Sequence[int]] = None,
                     block: bool = True,
-                    lane: str = LANE_CONSENSUS) -> VerifyFuture:
+                    lane: str = LANE_CONSENSUS,
+                    chain_id: Optional[str] = None) -> VerifyFuture:
         """Submit several signatures as ONE unit (e.g. a vote and its
         extension): one future, per-row verdicts, and — when counted —
         the group tally credits `power` only if EVERY row verifies.
@@ -838,7 +898,15 @@ class VerifyPlane:
         hint) instead of PlaneQueueFull, and may later be shed by the
         dispatcher if they age past the lane's deadline before a flush
         can take them. A blocking sheddable-lane submission whose
-        backpressure wait times out is shed the same explicit way."""
+        backpressure wait times out is shed the same explicit way.
+
+        `chain_id` keys the submission to its tenant
+        (verifyplane/tenants.py): the ledger attributes the rows, the
+        fair-share drain rotates between queued tenants, and a tenant
+        past its pending-row quota on a sheddable lane is shed
+        immediately with a TenantOverloaded verdict — a hard quota,
+        not backpressure, so waiting is never offered. CONSENSUS is
+        structurally outside every tenant gate."""
         if lane not in LANES:
             raise ValueError(f"unknown verify-plane lane {lane!r}")
         rows = list(rows)
@@ -846,10 +914,27 @@ class VerifyPlane:
             raise ValueError("empty submission")
         if not self._running or self.in_dispatcher():
             raise PlaneStopped("verify plane not accepting submissions")
-        sub = _Submission(rows, group, power, counted, vidx, lane=lane)
+        sub = _Submission(rows, group, power, counted, vidx, lane=lane,
+                          tenant=chain_id)
         limit = self.lane_limit[lane]
+        quota = (self.tenants.row_quota(sub.tenant)
+                 if lane in SHEDDABLE_LANES else 0)
         deadline = time.monotonic() + DEFAULT_RESULT_TIMEOUT
         with self._cv:
+            if quota:
+                pend = self._pending_tenant_rows[lane].get(sub.tenant, 0)
+                if pend and pend + len(rows) > quota:
+                    self._shed_count(1, lane)
+                    self.tenants.note_shed(sub.tenant, lane)
+                    from cometbft_tpu.verifyplane.tenants import \
+                        TenantOverloaded
+
+                    raise TenantOverloaded(
+                        f"tenant {sub.tenant!r} past its {quota}-row "
+                        f"{lane} quota",
+                        retry_after_ms=self._retry_hint_ms(lane),
+                        tenant=sub.tenant,
+                    )
             # backpressure gates on what is already queued in THIS lane
             # — a lone submission larger than the bound still enters an
             # empty queue (it dispatches alone) instead of deadlocking
@@ -882,6 +967,8 @@ class VerifyPlane:
                 raise PlaneStopped("verify plane stopped")
             self._pending[lane].append(sub)
             self._pending_rows[lane] += len(rows)
+            tpend = self._pending_tenant_rows[lane]
+            tpend[sub.tenant] = tpend.get(sub.tenant, 0) + len(rows)
             depth = self._depth_locked()
             if self.metrics is not None:
                 self.metrics.plane_queue_depth.set(depth)
@@ -893,6 +980,17 @@ class VerifyPlane:
 
     def _depth_locked(self) -> int:
         return sum(self._pending_rows[lane] for lane in LANES)
+
+    def _tenant_unpend(self, lane: str, sub: "_Submission") -> None:
+        """_cv held: release a dequeued submission's rows from the
+        per-(lane, tenant) pending split (entries drop at zero so the
+        dict never grows with retired tenants)."""
+        tpend = self._pending_tenant_rows[lane]
+        n = tpend.get(sub.tenant, 0) - len(sub.rows)
+        if n > 0:
+            tpend[sub.tenant] = n
+        else:
+            tpend.pop(sub.tenant, None)
 
     def _retry_hint_ms(self, lane: str = LANE_BULK) -> float:
         """Honest backoff hint for shed callers: the lane's deadline is
@@ -917,10 +1015,12 @@ class VerifyPlane:
 
     def submit_and_wait(self, pubs, msgs, sigs,
                         timeout: Optional[float] = None,
-                        lane: str = LANE_CONSENSUS) -> np.ndarray:
+                        lane: str = LANE_CONSENSUS,
+                        chain_id: Optional[str] = None) -> np.ndarray:
         """crypto.batch.verify_batch shape: (n,) bool validity through
         the plane (one submission, one flush slot)."""
-        fut = self.submit_many(list(zip(pubs, msgs, sigs)), lane=lane)
+        fut = self.submit_many(list(zip(pubs, msgs, sigs)), lane=lane,
+                               chain_id=chain_id)
         if timeout is None:
             # scale with batch size: a 10k-row host-path flush on a
             # 1-core box legitimately outlives the default window
@@ -1017,13 +1117,18 @@ class VerifyPlane:
                             and q[0].t_submit_led < cutoff:
                         sub = q.popleft()
                         self._pending_rows[lane] -= len(sub.rows)
+                        self._tenant_unpend(lane, sub)
                         shed.append(sub)
                 # weighted drain: whole CONSENSUS submissions first up
                 # to max_batch rows (a lone oversized submission still
                 # dispatches alone), then GATEWAY and finally BULK fill
                 # the remaining capacity — each with its guaranteed
                 # anti-starvation quantum, so every lane makes progress
-                # even under a sustained higher-priority storm
+                # even under a sustained higher-priority storm.
+                # CONSENSUS drains whole with NO tenant gate in the
+                # loop — per-tenant unsheddability is structural here,
+                # exactly like the lane wall: no quota, no rotation,
+                # no code path that could skip one tenant's votes.
                 rows = 0
                 cq = self._pending[LANE_CONSENSUS]
                 while cq:
@@ -1032,22 +1137,14 @@ class VerifyPlane:
                         break
                     sub = cq.popleft()
                     self._pending_rows[LANE_CONSENSUS] -= nxt
+                    self._tenant_unpend(LANE_CONSENSUS, sub)
                     rows += nxt
                     batch.append(sub)
                 quantum = max(1, self.max_batch // BULK_QUANTUM_DIV)
                 for lane in SHEDDABLE_LANES:
                     q = self._pending[lane]
                     budget = max(self.max_batch - rows, quantum)
-                    lrows = 0
-                    while q:
-                        nxt = len(q[0].rows)
-                        if batch and lrows + nxt > budget:
-                            break
-                        sub = q.popleft()
-                        self._pending_rows[lane] -= nxt
-                        lrows += nxt
-                        batch.append(sub)
-                    rows += lrows
+                    rows += self._drain_sheddable(lane, q, budget, batch)
                 depth = self._depth_locked()
                 if self.metrics is not None:
                     self.metrics.plane_queue_depth.set(depth)
@@ -1055,6 +1152,7 @@ class VerifyPlane:
             if shed:
                 for sub in shed:
                     self._shed_count(1, sub.lane)
+                    self.tenants.note_shed(sub.tenant, sub.lane)
                     sub.future._fail(PlaneOverloaded(
                         f"verify plane shed {sub.lane} submission past "
                         f"its "
@@ -1073,7 +1171,7 @@ class VerifyPlane:
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, 0, PATH_SHED_ONLY,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed), 0, 0, 0, 0, 0.0, 0.0, 0.0, 0.0,
+                        len(shed), 0, 0, 0, 0, 0.0, 0.0, 0.0, 0.0, (),
                     ])
             if not batch:
                 # nothing to pack: land a flight (the first READY one,
@@ -1117,6 +1215,76 @@ class VerifyPlane:
                 self._finish_flight(flight)
         while deck:
             self._land_one(deck)
+
+    def _drain_sheddable(self, lane: str, q, budget: int,
+                         batch: List[_Submission]) -> int:
+        """_cv held: fill up to `budget` rows from one sheddable lane
+        into `batch`; returns the rows taken. With ONE tenant queued
+        this is the original FIFO loop (O(1) dict probe, no extra
+        work on the single-chain plane). With several, the fair-share
+        drain: submissions bucket per tenant (FIFO within each), the
+        registry's rotation cursor picks the cycle's order, and each
+        tenant gets an equal share of the budget before a second pass
+        hands unused capacity back out in the same rotation order —
+        so a flooding tenant can fill leftover capacity but can never
+        crowd a quieter tenant out of its slice, and the head-of-line
+        position rotates instead of favoring one chain forever."""
+        if len(self._pending_tenant_rows[lane]) <= 1:
+            lrows = 0
+            while q:
+                nxt = len(q[0].rows)
+                if batch and lrows + nxt > budget:
+                    break
+                sub = q.popleft()
+                self._pending_rows[lane] -= nxt
+                self._tenant_unpend(lane, sub)
+                lrows += nxt
+                batch.append(sub)
+            return lrows
+        buckets: dict = {}
+        for sub in q:
+            buckets.setdefault(sub.tenant, []).append(sub)
+        order = self.tenants.drain_order(buckets)
+        share = max(1, budget // len(order))
+        taken_ids = set()
+        lrows = 0
+        # pass 1: each tenant up to its equal share (oldest first)
+        for name in order:
+            b = buckets[name]
+            trows = 0
+            while b:
+                nxt = len(b[0].rows)
+                if batch and (trows + nxt > share
+                              or lrows + nxt > budget):
+                    break
+                sub = b.pop(0)
+                trows += nxt
+                lrows += nxt
+                taken_ids.add(id(sub))
+                batch.append(sub)
+        # pass 2: leftover capacity (tenants under their share left
+        # some) goes back out greedily in the same rotation order
+        for name in order:
+            b = buckets[name]
+            while b:
+                nxt = len(b[0].rows)
+                if batch and lrows + nxt > budget:
+                    break
+                sub = b.pop(0)
+                lrows += nxt
+                taken_ids.add(id(sub))
+                batch.append(sub)
+            if batch and b:
+                break  # budget exhausted mid-bucket
+        if taken_ids:
+            remaining = [s for s in q if id(s) not in taken_ids]
+            q.clear()
+            q.extend(remaining)
+            for sub in batch:
+                if id(sub) in taken_ids:
+                    self._pending_rows[lane] -= len(sub.rows)
+                    self._tenant_unpend(lane, sub)
+        return lrows
 
     def _land_one(self, deck: List[_Flight]) -> None:
         """Land one deck flight: the first READY one (out-of-order —
@@ -1283,8 +1451,10 @@ class VerifyPlane:
         rows = 0
         c_rows = 0
         g_rows = 0
+        tens: dict = {}
         for s in batch:
             rows += len(s.rows)
+            tens[s.tenant] = tens.get(s.tenant, 0) + len(s.rows)
             if s.lane == LANE_CONSENSUS:
                 c_rows += len(s.rows)
             elif s.lane == LANE_GATEWAY:
@@ -1304,7 +1474,8 @@ class VerifyPlane:
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, 0,
                PATH_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
-               0, 0, 0.0, 0.0, 0.0, 0.0, t0, t0, gen, 0]
+               0, 0, 0.0, 0.0, 0.0, 0.0, tuple(sorted(tens.items())),
+               t0, t0, gen, 0]
         for s in batch:
             # the join key consumers read AFTER the future resolves
             # (height ledger -> /dump_flushes attribution)
@@ -1582,8 +1753,10 @@ class VerifyPlane:
                     and sub.group is not None and all(sl):
                 sub.group.add(sub.power)
             self.lane_rows[sub.lane] += len(sub.rows)
-            self.lane_waits[sub.lane].append(
-                (now - sub.t_submit) * 1000.0)
+            wait_ms = (now - sub.t_submit) * 1000.0
+            self.lane_waits[sub.lane].append(wait_ms)
+            self.tenants.note_served(sub.tenant, sub.lane,
+                                     len(sub.rows), wait_ms)
             if self.metrics is not None:
                 self.metrics.plane_wait_seconds.observe(now - sub.t_submit)
                 self.metrics.plane_lane_rows.inc(len(sub.rows),
@@ -1685,7 +1858,14 @@ class VerifyPlane:
             "halves": len(self._halves),
             "deck_airborne": self.deck_airborne,
             "deck_peak": self.deck_peak,
+            "tenants": len(self.tenants.tenants()),
         }
+
+    def tenant_depths(self) -> dict:
+        """Per-(lane, tenant) pending rows (the quota gate's view)."""
+        with self._cv:
+            return {lane: dict(t)
+                    for lane, t in self._pending_tenant_rows.items()}
 
     def lane_depths(self) -> dict:
         """Per-lane pending rows (scrape-time gauge source)."""
@@ -1729,6 +1909,13 @@ def set_global_plane(plane: Optional[VerifyPlane]) -> None:
         _GLOBAL = plane
         if plane is not None:
             _LAST = plane
+    # the tenancy registry mirrors the plane (one registry per plane):
+    # /dump_tenants and the /metrics tenant families follow whichever
+    # plane is mounted, with the same _LAST survival contract
+    from cometbft_tpu.verifyplane import tenants as vtenants
+
+    vtenants.set_global_registry(None if plane is None
+                                 else plane.tenants)
 
 
 def clear_global_plane(plane: VerifyPlane) -> None:
@@ -1738,6 +1925,9 @@ def clear_global_plane(plane: VerifyPlane) -> None:
     with _GLOBAL_LOCK:
         if _GLOBAL is plane:
             _GLOBAL = None
+    from cometbft_tpu.verifyplane import tenants as vtenants
+
+    vtenants.clear_global_registry(plane.tenants)
 
 
 def global_plane() -> Optional[VerifyPlane]:

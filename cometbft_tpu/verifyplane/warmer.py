@@ -91,6 +91,9 @@ class TableWarmer:
         self.builds_ok = 0
         self.builds_failed = 0
         self.builds_skipped = 0
+        # the subset of skips refused by a tenant's HBM residency
+        # budget (verifyplane/tenants.py warm gate); always <= skipped
+        self.builds_skipped_quota = 0
         self.builds_incremental = 0
         self.superseded = 0
         self.last_build_ms = 0.0
@@ -124,10 +127,15 @@ class TableWarmer:
 
     # -- requests ----------------------------------------------------------
 
-    def request(self, pubs, powers) -> None:
+    def request(self, pubs, powers,
+                chain_id: Optional[str] = None) -> None:
         """Warm the table for (pubs, powers). Latest-wins: an unstarted
         older request is superseded (epoch e+2 announced before e+1's
-        build began means e+1's table would be dead on arrival)."""
+        build began means e+1's table would be dead on arrival).
+        `chain_id` attributes the warm to the owning tenant
+        (verifyplane/tenants.py): the build is gated on the tenant's
+        HBM residency budget and the built table's owner is recorded
+        for per-tenant residency accounting."""
         pubs = tuple(pubs)
         powers = None if powers is None else tuple(powers)
         with self._cv:
@@ -135,17 +143,19 @@ class TableWarmer:
                 return
             if self._req is not None:
                 self.superseded += 1
-            self._req = (pubs, powers)
+            self._req = (pubs, powers, chain_id)
             self._cv.notify_all()
 
-    def request_valset(self, vals) -> None:
+    def request_valset(self, vals,
+                       chain_id: Optional[str] = None) -> None:
         """Warm for a types.validator.ValidatorSet. Column extraction
         happens HERE on the caller's thread (O(n), ~ms at 10k): the set
         keeps mutating (proposer-priority rotation) after apply_block
         returns, but keys and powers — all the table depends on — do
         not."""
         self.request(tuple(v.pub_key.data for v in vals.validators),
-                     tuple(v.voting_power for v in vals.validators))
+                     tuple(v.voting_power for v in vals.validators),
+                     chain_id=chain_id)
 
     # -- the build loop ----------------------------------------------------
 
@@ -183,7 +193,8 @@ class TableWarmer:
 
         return bool(cbatch._accel_backend())
 
-    def _build(self, pubs: tuple, powers: Optional[tuple]) -> None:
+    def _build(self, pubs: tuple, powers: Optional[tuple],
+               chain_id: Optional[str] = None) -> None:
         try:
             fp.fail_point("warmer.build")
         except Exception:  # noqa: BLE001 - injected fault: cold path
@@ -198,12 +209,20 @@ class TableWarmer:
             # very device the breaker is resting
             self.builds_skipped += 1
             return
+        if not self._tenant_allows(chain_id, len(pubs)):
+            # residency-budget refusal: the tenant's cold tables were
+            # already evicted (its own retired epochs go first) and the
+            # warm STILL would not fit — skip, count, cold path. The
+            # live epoch keeps verifying; only the prefetch is denied.
+            self.builds_skipped += 1
+            self.builds_skipped_quota += 1
+            return
         t0 = time.perf_counter()
         try:
             if self._build_fn is not None:
                 self._build_fn(pubs, powers)
             elif self._device_ok():
-                self._build_default(pubs, powers)
+                self._build_default(pubs, powers, chain_id)
             else:
                 self.builds_skipped += 1
                 return
@@ -218,7 +237,32 @@ class TableWarmer:
         tracing.instant("warmer.built", cat="verifyplane",
                         vals=len(pubs), ms=self.last_build_ms)
 
-    def _build_default(self, pubs: tuple, powers: Optional[tuple]) -> None:
+    def _tenant_allows(self, chain_id: Optional[str],
+                       nvals: int) -> bool:
+        """The tenant residency gate: a warm for a budgeted tenant that
+        would breach its HBM residency budget is refused — AFTER one
+        attempt to make room by evicting the tenant's own cold tables
+        (the noisy-neighbor contract: a tenant over budget loses its
+        retired epochs first, never another tenant's tables). No
+        registry / no chain_id / unbudgeted tenant = always allowed."""
+        if chain_id is None:
+            return True
+        from cometbft_tpu.verifyplane import tenants as vtenants
+
+        reg = vtenants.global_registry()
+        if reg is None:
+            return True
+        est = vtenants.estimate_table_bytes(nvals)
+        if reg.warm_allowed(chain_id, est):
+            return True
+        reg.evict_cold_tables(chain_id)
+        if reg.warm_allowed(chain_id, est):
+            return True
+        reg.note_warm_skip(chain_id)
+        return False
+
+    def _build_default(self, pubs: tuple, powers: Optional[tuple],
+                       chain_id: Optional[str] = None) -> None:
         """The real device build: the plain table, plus the sharded
         per-device tables when the plane runs a mesh. Inserts ride the
         shared bounded caches (LRU: the LIVE epoch's table is the most
@@ -238,6 +282,14 @@ class TableWarmer:
         from cometbft_tpu.ops import table_cache as tcache
 
         key = ec._cache_key(pubs, powers)
+        if chain_id is not None:
+            # residency attribution: the registry's read-time walk of
+            # the live caches resolves this content key to its tenant
+            from cometbft_tpu.verifyplane import tenants as vtenants
+
+            reg = vtenants.global_registry()
+            if reg is not None:
+                reg.note_table_owner(key, chain_id)
         # PEEK before looking up: the consuming hit path would pop a
         # still-pending warm mark (a repeat notify for an identical
         # valset — e.g. a power re-set to its current value — must not
@@ -337,6 +389,7 @@ class TableWarmer:
             "builds_ok": self.builds_ok,
             "builds_failed": self.builds_failed,
             "builds_skipped": self.builds_skipped,
+            "builds_skipped_quota": self.builds_skipped_quota,
             "builds_incremental": self.builds_incremental,
             "superseded": self.superseded,
             "last_build_ms": self.last_build_ms,
@@ -382,11 +435,12 @@ def last_warmer() -> Optional[TableWarmer]:
     return _GLOBAL or _LAST
 
 
-def notify_next_valset(vals) -> None:
+def notify_next_valset(vals, chain_id: Optional[str] = None) -> None:
     """state/execution.py's seam: called with the epoch e+1 validator
     set whenever a block's validator updates produced one. A cheap
     no-op when no warmer is registered (simnet determinism: no warmer
-    runs there unless a test mounts one)."""
+    runs there unless a test mounts one). `chain_id` attributes the
+    warm to the owning tenant on a shared multi-chain plane."""
     w = global_warmer()
     if w is not None:
-        w.request_valset(vals)
+        w.request_valset(vals, chain_id=chain_id)

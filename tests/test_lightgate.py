@@ -322,7 +322,7 @@ def test_overload_shed_fans_out_with_hint():
             return False
 
         def submit_and_wait(self, pubs, msgs, sigs, timeout=None,
-                            lane="consensus"):
+                            lane="consensus", chain_id=None):
             raise PlaneOverloaded("gateway lane full",
                                   retry_after_ms=123.0)
 

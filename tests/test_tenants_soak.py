@@ -1,0 +1,408 @@
+"""Multi-tenant verify plane at simnet scale (ISSUE 17 acceptance).
+
+K chain groups share ONE process-global verify plane while chaos and a
+signed flood ride one of them:
+
+  * cross-tenant coalescing is ledger-evidenced — two chains' rows
+    queued together land in ONE fused flush whose per-tenant
+    attribution sums to the flush total;
+  * a tenant past its row quota is shed with an explicit retry-hinted
+    TenantOverloaded verdict, while another tenant's CONSENSUS lane
+    never sees a tenant gate;
+  * a real-thread noisy neighbor hammering the BULK lane is quota-shed
+    while the victim chains keep committing with bounded verify waits
+    and ZERO consensus sheds;
+  * the whole multi-chain run — chaos, flood, tenant ledger columns
+    and registry totals — replays byte-identically from (seed,
+    schedule), and a chain group's commits are bit-identical to the
+    SAME chain run solo (the shared plane changes the economics, never
+    the verdicts).
+
+Budget discipline follows test_soak.py: the expensive runs are built
+once in a module-scoped lazy cache and shared across tests.
+"""
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.simnet import Simnet
+from cometbft_tpu.verifyplane import (
+    LANE_BULK,
+    LANE_CONSENSUS,
+    PlaneOverloaded,
+    TenantOverloaded,
+    VerifyPlane,
+    set_global_plane,
+)
+
+pytestmark = pytest.mark.simnet
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+N_PER_CHAIN = 3
+SEED = 9090
+TARGET_H = 4
+
+# chaos on chain group 0 ONLY (nodes 0-2): a signed flood riding
+# simnet-0's BULK lane, garbage votes, and a partition that isolates
+# node 2 — with 3 equal validators that stalls chain 0 dead until the
+# heal. Group 1 (nodes 3-5) is scheduled NOTHING: it is the victim/
+# control chain, which is what makes the solo comparison and the
+# noisy-neighbor isolation assertions meaningful.
+MCHAOS = [
+    {"at": 0.6, "op": "flood", "node": 0, "rate": 20.0,
+     "duration": 3.0, "signed": True, "size": 24},
+    {"at": 0.8, "op": "garbage", "node": 2, "votes": 2},
+    {"at": 1.2, "op": "partition", "groups": [[0, 1], [2, 3, 4, 5]]},
+    {"at": 2.6, "op": "heal"},
+]
+
+
+class _InstaPub:
+    """Flooder row stub: instant verify (the noisy neighbor's load is
+    queue pressure, not crypto)."""
+
+    def verify_signature(self, msg, sig):
+        return True
+
+
+class _GatePub:
+    """Blocker row: parks the dispatcher inside a verify until
+    released, so the test can queue multi-tenant traffic behind it
+    deterministically (the plane's only concurrency seam a
+    single-threaded simnet never exercises)."""
+
+    def __init__(self):
+        self.busy = threading.Event()
+        self.release = threading.Event()
+
+    def verify_signature(self, msg, sig):
+        self.busy.set()
+        self.release.wait(timeout=10.0)
+        return True
+
+
+def _coalesce_demo(plane, privs, chains):
+    """Drive the cross-tenant coalescing + quota-shed acceptance
+    scenario through the still-running shared plane with the sim
+    chains' REAL validator keys: park the dispatcher, queue BULK rows
+    from BOTH chains plus a victim CONSENSUS row, shed the flooder
+    past its quota, release — and read the ONE fused flush back off
+    the ledger."""
+    pre = plane.ledger.records()
+    mark_seq = pre[-1]["seq"] if pre else -1
+    gate = _GatePub()
+
+    def rows(group, n, msg):
+        out = []
+        for i in range(n):
+            priv = privs[group * N_PER_CHAIN + i % N_PER_CHAIN]
+            out.append((priv.pub_key(), msg, priv.sign(msg)))
+        return out
+
+    plane.tenants.register(chains[0], row_quota=3)
+    blocker = plane.submit_many([(gate, b"blk", b"sig")],
+                                lane=LANE_BULK, block=False,
+                                chain_id=chains[0])
+    assert gate.busy.wait(5.0), "dispatcher never picked up the blocker"
+    # dispatcher parked: everything below queues with no races
+    f0 = plane.submit_many(rows(0, 2, b"bulk0"), lane=LANE_BULK,
+                           block=False, chain_id=chains[0])
+    f1 = plane.submit_many(rows(1, 2, b"bulk1"), lane=LANE_BULK,
+                           block=False, chain_id=chains[1])
+    shed = None
+    try:
+        plane.submit_many(rows(0, 2, b"over"), lane=LANE_BULK,
+                          block=False, chain_id=chains[0])
+    except TenantOverloaded as e:
+        shed = {"tenant": e.tenant, "retry_after_ms": e.retry_after_ms,
+                "msg": str(e), "is_overload": isinstance(
+                    e, PlaneOverloaded)}
+    # the victim's CONSENSUS row is outside every tenant gate
+    fc = plane.submit_many(rows(1, 1, b"vote"), lane=LANE_CONSENSUS,
+                           chain_id=chains[1], block=False)
+    import time as _time
+
+    _time.sleep(0.02)  # age the bulk rows past the bulk window
+    gate.release.set()
+    verdicts = {
+        "blocker": blocker.result(5), "f0": f0.result(5),
+        "f1": f1.result(5), "fc": fc.result(5),
+    }
+    recs = [{"rows": r["rows"], "c_rows": r["c_rows"],
+             "b_rows": r["b_rows"], "tenants": r["tenants"]}
+            for r in plane.ledger.records() if r["seq"] > mark_seq]
+    return {"shed": shed, "verdicts": verdicts, "records": recs}
+
+
+def _victim_commit_p99(sim, group):
+    out = []
+    for n in sim.net.group_nodes(group):
+        if n.alive:
+            s = n.node.consensus.height_ledger.summary()
+            out.append(s["commit_latency_ms"]["p99"])
+    return out
+
+
+def _canon_registry(dump):
+    """The registry dump's deterministic columns (wait quantiles ride
+    the real clock and are excluded)."""
+    return {
+        name: {k: t[k] for k in ("rows", "lane_rows", "lane_sheds",
+                                 "warm_skips", "cold_evictions")}
+        for name, t in dump["tenants"].items()
+    }
+
+
+def _run_multichain(basedir, noisy: bool, seed: int = SEED):
+    """One K-chains-one-plane run; `noisy` adds a REAL-thread flooder
+    tenant hammering the shared BULK lane open-loop for the whole
+    run."""
+    plane = VerifyPlane(window_ms=0.5, use_device=False,
+                        bulk_deadline_ms=250.0)
+    plane.start()
+    set_global_plane(plane)
+    stop = threading.Event()
+    flood_counts = {"ok": 0, "tenant_shed": 0, "queue_shed": 0}
+    shed_sample = {}
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                plane.submit_many([(_InstaPub(), b"m", b"s")] * 16,
+                                  lane=LANE_BULK, block=False,
+                                  chain_id="flooder")
+                flood_counts["ok"] += 1
+            except TenantOverloaded as e:
+                flood_counts["tenant_shed"] += 1
+                shed_sample.setdefault("err", {
+                    "tenant": e.tenant,
+                    "retry_after_ms": e.retry_after_ms,
+                    "msg": str(e)})
+            except PlaneOverloaded:
+                flood_counts["queue_shed"] += 1
+            stop.wait(0.001)
+
+    thread = None
+    try:
+        with Simnet(N_PER_CHAIN, seed=seed, basedir=str(basedir),
+                    n_chains=2) as sim:
+            chains = list(sim.net.chain_ids)
+            if noisy:
+                plane.tenants.register("flooder", row_quota=24)
+                thread = threading.Thread(target=hammer, daemon=True)
+                thread.start()
+            assert sim.run(MCHAOS, until_height=TARGET_H,
+                           max_time=90.0), \
+                "multichain run never reached target height"
+            if thread is not None:
+                stop.set()
+                thread.join(timeout=5.0)
+            hashes = sim.commit_hashes()
+            flood_results = list(sim.flood_results)
+            victim_p99 = _victim_commit_p99(sim, 1)
+            heights = [n.height() for n in sim.net.nodes if n.alive]
+            demo = (None if noisy else
+                    _coalesce_demo(plane, list(sim.net.privs), chains))
+    finally:
+        stop.set()
+        set_global_plane(None)
+        plane.stop()
+    led = [{"rows": r["rows"], "c_rows": r["c_rows"],
+            "b_rows": r["b_rows"], "tenants": r["tenants"]}
+           for r in plane.ledger.records()]
+    return {
+        "chains": chains, "hashes": hashes, "heights": heights,
+        "flood_results": flood_results, "victim_p99": victim_p99,
+        "demo": demo, "ledger": led,
+        "summary": plane.ledger.summary(),
+        "stats": plane.stats(), "registry": plane.tenants.dump(),
+        "flood_counts": dict(flood_counts),
+        "shed_sample": dict(shed_sample),
+    }
+
+
+def _run_solo_group1(basedir, seed: int = SEED):
+    """Chain group 1, run ALONE: same keys (seed+1 derivation), same
+    chain_id, no shared plane — the bit-identical control."""
+    with Simnet(N_PER_CHAIN, seed=seed + 1, basedir=str(basedir),
+                chain_id="simnet-1") as sim:
+        assert sim.run([], until_height=TARGET_H, max_time=60.0)
+        sim.assert_safety()
+        return sim.commit_hashes()
+
+
+@pytest.fixture(scope="module")
+def tenant_runs(tmp_path_factory):
+    """Lazy shared cache: "multi_a"/"multi_b" are the identical
+    (seed, schedule) replay pair; "noisy" adds the real-thread
+    flooder; "solo" is group 1 run alone."""
+    runs = {}
+
+    def get(kind):
+        if kind not in runs:
+            fp.reset()
+            base = tmp_path_factory.mktemp(kind)
+            if kind == "solo":
+                runs[kind] = _run_solo_group1(base)
+            else:
+                runs[kind] = _run_multichain(base,
+                                             noisy=(kind == "noisy"))
+        return runs[kind]
+
+    return get
+
+
+def _group_safety(hashes):
+    """Per-group agreement (the harness's assert_safety spans groups,
+    which legitimately diverge): within a group, no two nodes commit
+    different blocks at one height."""
+    for g in range(2):
+        agreed = {}
+        for h in hashes[g * N_PER_CHAIN:(g + 1) * N_PER_CHAIN]:
+            for height, bh in h.items():
+                assert agreed.setdefault(height, bh) == bh, \
+                    f"group {g} split at height {height}"
+
+
+def test_multichain_one_plane_coalesces(tenant_runs):
+    """K chains, ONE plane: both chain tenants flowed through it, the
+    ledger's per-flush tenant attribution always sums to the flush
+    total, and the parked-dispatcher demo produced ONE fused flush
+    carrying BOTH chains' rows — with the over-quota flooder shed as
+    an explicit retry-hinted TenantOverloaded and the victim's
+    CONSENSUS row verified ungated."""
+    run = tenant_runs("multi_a")
+    _group_safety(run["hashes"])
+    assert all(h >= TARGET_H for h in run["heights"])
+    # the sim traffic itself was tenant-keyed: both chains' rows are
+    # in the registry and in the ledger's per-tenant totals
+    reg = run["registry"]["tenants"]
+    for chain in run["chains"]:
+        assert reg[chain]["rows"] > 0, reg.keys()
+        assert run["summary"]["tenants"][chain] > 0
+    # every flush's attribution sums exactly to its row count
+    for r in run["ledger"]:
+        assert sum(n for _, n in r["tenants"]) == r["rows"], r
+    # the coalescing demo: one fused flush, two chains, sums exact
+    demo = run["demo"]
+    fused = [r for r in demo["records"] if len(r["tenants"]) >= 2]
+    assert fused, demo["records"]
+    split = dict(fused[0]["tenants"])
+    assert split == {run["chains"][0]: 2, run["chains"][1]: 3}
+    assert fused[0]["c_rows"] == 1 and fused[0]["b_rows"] == 4
+    assert run["summary"]["coalesced_flushes"] >= 1
+    # real keys, real signatures: everything verified True
+    assert demo["verdicts"]["f0"] == (True, True)
+    assert demo["verdicts"]["f1"] == (True, True)
+    assert demo["verdicts"]["fc"] == (True,)
+    # the quota shed was explicit, attributed, and retry-hinted
+    shed = demo["shed"]
+    assert shed is not None, "over-quota submission was not shed"
+    assert shed["tenant"] == run["chains"][0]
+    assert shed["retry_after_ms"] > 0
+    assert shed["is_overload"]  # mempool/lightgate arms catch it as-is
+    assert "quota" in shed["msg"]
+    assert reg[run["chains"][0]]["lane_sheds"][LANE_BULK] >= 1
+
+
+def test_multichain_flood_is_answered_and_consensus_unshed(tenant_runs):
+    """The chaos half held QoS: flooded txs got explicit verdicts,
+    overloads (if any) carried retry hints, and CONSENSUS was never
+    shed for ANY tenant."""
+    run = tenant_runs("multi_a")
+    results = run["flood_results"]
+    answered = [r for r in results if r["code"] is not None]
+    assert answered, "no flood tx ever reached a live mempool"
+    assert any(r["code"] == abci.CODE_TYPE_OK for r in answered)
+    for r in answered:
+        if r["code"] == abci.CODE_TYPE_OVERLOADED:
+            assert "retry_after_ms=" in r["log"], r
+    assert run["stats"]["sheds"]["consensus"] == 0
+    for t in run["registry"]["tenants"].values():
+        assert t["lane_sheds"][LANE_CONSENSUS] == 0, t
+
+
+def test_multichain_deterministic_replay(tenant_runs):
+    """Same (seed, schedule) twice: identical commit hashes on every
+    node of every chain, identical flood verdict stream, identical
+    tenant-attributed ledger columns, and identical registry totals —
+    the multi-tenant surfaces are part of the deterministic record."""
+    a, b = tenant_runs("multi_a"), tenant_runs("multi_b")
+    assert a["hashes"] == b["hashes"]
+    assert [(r["seq"], r["code"], r["log"]) for r in a["flood_results"]] \
+        == [(r["seq"], r["code"], r["log"]) for r in b["flood_results"]]
+    cols = lambda led: [(r["rows"], r["c_rows"], r["b_rows"],  # noqa: E731
+                         r["tenants"]) for r in led]
+    assert cols(a["ledger"]) == cols(b["ledger"])
+    assert a["summary"]["tenants"] == b["summary"]["tenants"]
+    assert _canon_registry(a["registry"]) == \
+        _canon_registry(b["registry"])
+    assert a["demo"] == b["demo"]
+
+
+def test_shared_plane_group_matches_solo_run(tenant_runs):
+    """Sharing the plane changes the economics, never the chain: group
+    1 of the 2-chain run commits bit-identical blocks to the SAME
+    chain (same keys, same chain_id) run alone with no shared plane."""
+    multi = tenant_runs("multi_a")
+    solo = tenant_runs("solo")
+    for j in range(N_PER_CHAIN):
+        shared_node = multi["hashes"][N_PER_CHAIN + j]
+        solo_node = solo[j]
+        common = sorted(set(shared_node) & set(solo_node))
+        assert len(common) >= TARGET_H, (len(shared_node),
+                                         len(solo_node))
+        for h in common:
+            assert shared_node[h] == solo_node[h], \
+                f"node {j} diverged from solo at height {h}"
+
+
+def test_noisy_neighbor_is_contained(tenant_runs):
+    """A real-thread flooder tenant hammering the shared BULK lane
+    open-loop for the whole run is quota-shed explicitly — and the
+    victim chains never notice: all chains commit to target, consensus
+    sheds stay ZERO for everyone, the victims shed nothing at all, and
+    the victim chain's commit p99 holds against the flood-free run."""
+    run = tenant_runs("noisy")
+    base = tenant_runs("multi_a")
+    _group_safety(run["hashes"])
+    assert all(h >= TARGET_H for h in run["heights"])
+    # the flooder really flooded, and was really quota-shed
+    counts = run["flood_counts"]
+    assert counts["ok"] > 0, counts
+    assert counts["tenant_shed"] > 0, counts
+    err = run["shed_sample"]["err"]
+    assert err["tenant"] == "flooder"
+    assert err["retry_after_ms"] > 0
+    assert "quota" in err["msg"]
+    reg = run["registry"]["tenants"]
+    assert reg["flooder"]["lane_sheds"][LANE_BULK] == \
+        counts["tenant_shed"]
+    # containment: zero consensus sheds anywhere, zero sheds of ANY
+    # kind for the victim chains
+    assert run["stats"]["sheds"]["consensus"] == 0
+    for chain in run["chains"]:
+        assert all(v == 0 for v in reg[chain]["lane_sheds"].values()), \
+            (chain, reg[chain]["lane_sheds"])
+    # victim commit p99 holds vs the flooder-free run (generous floor:
+    # the bound exists to catch cross-tenant starvation, not 1-core
+    # scheduler jitter)
+    assert run["victim_p99"] and base["victim_p99"]
+    limit = max(2.0 * max(base["victim_p99"]), 100.0)
+    assert max(run["victim_p99"]) <= limit, \
+        (run["victim_p99"], base["victim_p99"])
+    # the victim tenant's verify waits stayed sane under the flood
+    wait = run["registry"]["tenants"][run["chains"][1]]["wait_ms"]
+    assert wait["n"] > 0
+    base_wait = base["registry"]["tenants"][base["chains"][1]]["wait_ms"]
+    assert wait["p99_ms"] <= max(2.0 * base_wait["p99_ms"], 100.0), \
+        (wait, base_wait)

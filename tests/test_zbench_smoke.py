@@ -36,7 +36,7 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 10  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 11  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
@@ -44,8 +44,9 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert sorted(results) == ["cfg10_smoke", "cfg11_smoke",
                                "cfg12_smoke", "cfg13_smoke",
                                "cfg14_smoke", "cfg15_smoke",
-                               "cfg16_smoke", "cfg2_smoke",
-                               "cfg4_smoke", "cfg6_smoke"]
+                               "cfg16_smoke", "cfg17_smoke",
+                               "cfg2_smoke", "cfg4_smoke",
+                               "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -91,6 +92,18 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert all(ct["checks"].values()), ct["checks"]
     assert ct["decisions_total"] >= 6
     assert ct["controller_dump"]["decisions"], ct["controller_dump"]
+    # the cfg17 miniature proved the multi-tenant pod: identical
+    # verdicts shared vs split, fused cross-tenant flushes with exact
+    # per-tenant attribution, and the embedded /dump_tenants document
+    # tools/tenant_report.py reads
+    tn = results["cfg17_smoke"]["extra"]
+    assert all(tn["checks"].values()), tn["checks"]
+    assert tn["coalesced_flushes"] >= 1
+    assert tn["flushes_shared"] <= tn["flushes_split"]
+    # residency attribution may add a "default" entry for tables other
+    # smoke configs left in the process-global cache — the bench
+    # tenants themselves must both be present with their full rows
+    assert {"bench-0", "bench-1"} <= set(tn["tenants_dump"]["tenants"])
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

@@ -50,6 +50,12 @@ _UTIL_CFGS = {"cfg11": "util_big", "cfg12": "util_est"}
 # recorded round, same as the device/commit sub-rows
 _CONTROLLER_CFGS = ("cfg16",)
 
+# cfg17 embeds the multi-tenant pod figures: a "cfg17 pod" sub-row
+# tracks coalesced flushes and the shared-vs-split speedup (the
+# subsystem's one job is serving K chains from one drain cycle) —
+# '—' before its first recorded round, same as the other sub-rows
+_TENANT_CFGS = ("cfg17",)
+
 
 def _cfg_key(name: str):
     if name == "headline":
@@ -144,6 +150,21 @@ def history(rounds: dict) -> dict:
                     "vs_baseline": None,
                 })
             series[f"{cfg} loop"] = lpts
+        if cfg in _TENANT_CFGS:
+            tpts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                co = extra.get("coalesced_flushes")
+                sp = extra.get("speedup_vs_split")
+                tpts.append({
+                    "round": tag,
+                    "value": (f"{co}co/{sp:g}x"
+                              if co is not None and sp is not None
+                              else None),
+                    "unit": "coalesced/speedup",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} pod"] = tpts
         if cfg in _COMMIT_LATENCY_CFGS:
             cpts = []
             for tag in rounds:
