@@ -24,6 +24,7 @@ compact packed batch); the raw single-shot p50 (tunnel round trip
 included) is reported alongside.
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -2802,6 +2803,423 @@ def cfg17_tenants(k_chains=8, rounds=12, rows_per_sub=16):
     }
 
 
+def _catchup_history(n_blocks, n_vals=3, epoch_len=0,
+                     chain_id="cfg18-chain"):
+    """A real ed25519-signed history: per-epoch valsets (rotated every
+    ``epoch_len`` blocks when set), real Block objects whose
+    block_id()s the commits actually sign. Returns (items, vals_at)
+    with items = {h: (block, commit)} and vals_at(h) the valset that
+    signs block h."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import Block, Data, Header
+    from cometbft_tpu.types.commit import (
+        BLOCK_ID_FLAG_COMMIT,
+        Commit,
+        CommitSig,
+    )
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    n_epochs = (n_blocks // epoch_len + 2) if epoch_len else 1
+    epochs = []
+    for e in range(n_epochs):
+        privs = [PrivKey.generate(bytes([40 + e, i + 1]) + b"\x18" * 30)
+                 for i in range(n_vals)]
+        vs = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        epochs.append((vs, by_addr))
+
+    def vals_at(h):
+        e = (h - 1) // epoch_len if epoch_len else 0
+        return epochs[min(e, n_epochs - 1)][0]
+
+    items = {}
+    last_bid = None
+    for h in range(1, n_blocks + 1):
+        vs, by_addr = epochs[min((h - 1) // epoch_len
+                                 if epoch_len else 0, n_epochs - 1)]
+        hdr = Header(
+            chain_id=chain_id, height=h,
+            time=Timestamp(1700000000 + h, 0),
+            validators_hash=vs.hash(),
+            next_validators_hash=vals_at(h + 1).hash(),
+            proposer_address=vs.validators[0].address,
+        )
+        if last_bid is not None:
+            hdr.last_block_id = last_bid
+        blk = Block(hdr, Data())
+        blk.fill_header()
+        bid = blk.block_id()
+        sigs = []
+        for v in vs.validators:
+            ts = Timestamp(1700000000 + h, 1)
+            sb = canonical.canonical_vote_bytes(
+                chain_id, canonical.PRECOMMIT_TYPE, h, 0, bid, ts)
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                                  by_addr[v.address].sign(sb)))
+        items[h] = (blk, Commit(h, 0, bid, sigs))
+        last_bid = bid
+    return items, vals_at
+
+
+class _HistorySource:
+    """In-memory history source for the catch-up bench drivers."""
+
+    def __init__(self, items):
+        self.items = items
+
+    def base(self):
+        return min(self.items)
+
+    def tip(self):
+        return max(self.items)
+
+    def load(self, h):
+        return self.items[h]
+
+
+class _ReplayState:
+    """The slice of State the catch-up engine reads, without dragging
+    the execution stack into a bench driver."""
+
+    __slots__ = ("chain_id", "last_block_height", "validators",
+                 "next_validators")
+
+    def __init__(self, chain_id, h, validators, next_validators):
+        self.chain_id = chain_id
+        self.last_block_height = h
+        self.validators = validators
+        self.next_validators = next_validators
+
+
+class _RecordingWarmer:
+    def __init__(self):
+        self.requests = []
+
+    def request_valset(self, vals, chain_id=None):
+        self.requests.append((vals.hash(), chain_id))
+
+
+def _catchup_drive(items, vals_at, *, verifier, cursor_path,
+                   read_ahead=128, max_run=64, kill_at_read=0,
+                   warm_ahead=True, start_height=0):
+    """Run one CatchupEngine pass over an in-memory history. Returns
+    (engine, wall_ms, crashed) — with ``kill_at_read`` > 0 the
+    catchup.read_ahead failpoint raises at that read and the partial
+    run returns crashed=True (the persisted cursor is the evidence)."""
+    from cometbft_tpu.blocksync.catchup import CatchupEngine
+    from cometbft_tpu.libs import failpoints as fp
+
+    chain_id = getattr(items[min(items)][0].header, "chain_id",
+                       "cfg18-chain")
+    state = _ReplayState(chain_id, start_height,
+                         vals_at(start_height + 1),
+                         vals_at(start_height + 2))
+
+    def apply_fn(st, blk, commit):
+        h = blk.header.height
+        return _ReplayState(st.chain_id, h, vals_at(h + 1),
+                            vals_at(h + 2))
+
+    warmer = _RecordingWarmer()
+    eng = CatchupEngine(
+        _HistorySource(items), state, apply_fn=apply_fn,
+        verifier=verifier, cursor_path=cursor_path,
+        read_ahead=read_ahead, max_run=max_run,
+        warm_ahead=warm_ahead, warmer=warmer)
+    crashed = False
+    if kill_at_read:
+        # flake fires on the Nth evaluation: a kill mid-replay, with
+        # whatever the cursor persisted by then as the resume point
+        fp.arm("catchup.read_ahead", "flake", kill_at_read, count=1)
+    t = _now_ms()
+    try:
+        eng.run()
+    except fp.FailpointError:
+        crashed = True
+    finally:
+        fp.disarm("catchup.read_ahead")
+    return eng, _now_ms() - t, crashed
+
+
+def smoke_catchup(n_blocks=12, n_vals=3, epoch_len=5):
+    """cfg18's host-only miniature: a real ed25519-signed history
+    replayed through the catch-up firehose with the jax-free host
+    verifier — fused cross-height segments bounded at REAL valset
+    boundaries, warm-ahead requests fired before each boundary, then a
+    mid-replay kill + resume from the persisted cursor re-verifying
+    ZERO already-verified blocks. The catchup_dump is embedded so
+    tools/catchup_report.py reads this --json-out file directly."""
+    import tempfile
+
+    from cometbft_tpu.blocksync.catchup import HostCommitVerifier
+
+    items, vals_at = _catchup_history(n_blocks, n_vals, epoch_len)
+    with tempfile.TemporaryDirectory() as td:
+        cursor = os.path.join(td, "cursor.json")
+        # phase 1: kill at the 8th read-ahead read
+        eng1, _, crashed = _catchup_drive(
+            items, vals_at, verifier=HostCommitVerifier(),
+            cursor_path=cursor, read_ahead=4, max_run=4,
+            kill_at_read=8)
+        assert crashed and eng1.cursor.applied >= 1
+        verified_at_crash = eng1.cursor.verified
+
+        # phase 2: resume from the persisted cursor + applied state
+        class _CountingVerifier(HostCommitVerifier):
+            def __init__(self):
+                self.heights = []
+
+            def verify(self, jobs):
+                self.heights.extend(j.height for j in jobs)
+                return super().verify(jobs)
+
+        v2 = _CountingVerifier()
+        eng2, wall_ms, crashed2 = _catchup_drive(
+            items, vals_at, verifier=v2, cursor_path=cursor,
+            read_ahead=4, max_run=4,
+            start_height=eng1.cursor.applied)
+        reverified = [h for h in v2.heights if h <= verified_at_crash]
+        checks = {
+            "resumed_clean": not crashed2,
+            "caught_up": eng2.state.last_block_height == n_blocks,
+            "zero_reverified": not reverified,
+            "cursor_resumed": eng2.cursor.resumed,
+            "boundaries_found": eng2.ledger.counters["boundaries"]
+            + eng1.ledger.counters["boundaries"] >= 1,
+            "warm_ahead_fired": eng2.ledger.counters["warm_requests"]
+            + eng1.ledger.counters["warm_requests"] >= 1,
+        }
+        assert all(checks.values()), checks
+        from cometbft_tpu.blocksync import catchup as catchup_mod
+
+        dump = catchup_mod.dump_catchup()
+        return {
+            "metric": "cfg18_smoke catch-up firehose",
+            "value": round(wall_ms, 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "extra": {
+                "blocks": n_blocks,
+                "verified_at_crash": verified_at_crash,
+                "reverified_after_resume": len(reverified),
+                "checks": checks,
+                "catchup_dump": dump,
+            },
+        }
+
+
+def _cfg18_machinery(n_blocks=100_000, epoch_len=10_000, max_run=64):
+    """The ≥100k-block synthetic replay: stub crypto (the engine
+    MACHINERY is the thing under test — read-ahead, segmentation,
+    cursor persistence, ledger accounting — not the host's ed25519
+    throughput), with a mid-replay kill + resume proving zero
+    re-verification at scale."""
+    import tempfile
+
+    class _FakeVals:
+        __slots__ = ("tag",)
+
+        def __init__(self, tag):
+            self.tag = tag
+
+        def hash(self):
+            return self.tag
+
+    class _FakeHeader:
+        __slots__ = ("validators_hash", "height")
+
+        def __init__(self, vh, h):
+            self.validators_hash = vh
+            self.height = h
+
+    class _FakeBlock:
+        __slots__ = ("header", "_bid")
+
+        def __init__(self, hdr):
+            self.header = hdr
+            self._bid = ("bid", hdr.height)
+
+        def block_id(self):
+            return self._bid
+
+    class _FakeSig:
+        __slots__ = ()
+        signature = b"\x01"
+
+    class _FakeCommit:
+        __slots__ = ("signatures",)
+
+        def __init__(self, sigs):
+            self.signatures = sigs
+
+    class _StubVerifier:
+        def __init__(self):
+            self.heights = []
+
+        def verify(self, jobs):
+            self.heights.extend(j.height for j in jobs)
+            return [None] * len(jobs)
+
+    n_epochs = n_blocks // epoch_len + 2
+    epoch_vals = [_FakeVals(b"epoch-%d" % e) for e in range(n_epochs)]
+
+    def vals_at(h):
+        return epoch_vals[min((h - 1) // epoch_len, n_epochs - 1)]
+
+    shared_sigs = tuple(_FakeSig() for _ in range(4))
+    items = {h: (_FakeBlock(_FakeHeader(vals_at(h).hash(), h)),
+                 _FakeCommit(shared_sigs))
+             for h in range(1, n_blocks + 1)}
+
+    with tempfile.TemporaryDirectory() as td:
+        cursor = os.path.join(td, "cursor.json")
+        v1 = _StubVerifier()
+        eng1, _, crashed = _catchup_drive(
+            items, vals_at, verifier=v1, cursor_path=cursor,
+            max_run=max_run, kill_at_read=n_blocks // 2)
+        assert crashed, "mid-replay kill did not fire"
+        verified_at_crash = eng1.cursor.verified
+        v2 = _StubVerifier()
+        eng2, wall_ms, crashed2 = _catchup_drive(
+            items, vals_at, verifier=v2, cursor_path=cursor,
+            max_run=max_run, start_height=eng1.cursor.applied)
+        reverified = sum(1 for h in v2.heights
+                         if h <= verified_at_crash)
+        resumed_blocks = n_blocks - eng1.cursor.applied
+        checks = {
+            "caught_up": eng2.state.last_block_height == n_blocks,
+            "resumed_clean": not crashed2,
+            "zero_reverified": reverified == 0,
+            # boundary crossings left after the resume point: epoch
+            # walls at k*epoch_len strictly below the tip
+            "every_boundary_found":
+                eng2.ledger.counters["boundaries"]
+                == (n_blocks - 1) // epoch_len
+                - eng1.cursor.applied // epoch_len,
+            "warm_ahead_per_boundary":
+                eng2.ledger.counters["warm_requests"]
+                >= eng2.ledger.counters["boundaries"],
+        }
+        assert all(checks.values()), checks
+        summary = eng2.ledger.summary()
+        return {
+            "blocks": n_blocks,
+            "epoch_len": epoch_len,
+            "resumed_blocks": resumed_blocks,
+            "verified_at_crash": verified_at_crash,
+            "reverified_after_resume": reverified,
+            "wall_ms": round(wall_ms, 3),
+            "blocks_per_s": round(
+                resumed_blocks / max(wall_ms, 1e-9) * 1000.0, 1),
+            "flushes": eng2.ledger.counters["flushes"],
+            "boundaries": eng2.ledger.counters["boundaries"],
+            "warm_requests": eng2.ledger.counters["warm_requests"],
+            "checks": checks,
+            "summary": summary,
+        }
+
+
+def _cfg18_host_machinery():
+    """cfg18 on a no-accelerator host: the firehose MACHINERY over the
+    full 100k-block synthetic history at host speed (no real sig
+    throughput here — that number needs the TPU round; clearly
+    labeled)."""
+    figs = _cfg18_machinery()
+    from cometbft_tpu.blocksync import catchup as catchup_mod
+
+    return {
+        "metric": "cfg18 catch-up firehose (host-only MACHINERY run)",
+        "value": figs["blocks_per_s"],
+        "unit": "blocks/s",
+        "vs_baseline": None,
+        "extra": {
+            "host_only": True,
+            "machinery": {k: v for k, v in figs.items()
+                          if k != "summary"},
+            "catchup_dump": catchup_mod.dump_catchup(),
+            "note": "no accelerator: engine machinery blocks/s over a "
+                    "100k-block synthetic history with stub crypto; "
+                    "real sigs/s needs the TPU round",
+        },
+    }
+
+
+def cfg18_catchup(n_blocks=768, n_vals=64, epoch_len=256):
+    """#18: the archival catch-up firehose. Host machinery figures ride
+    a 100k-block synthetic replay (kill mid-replay, resume, ZERO
+    re-verified); on a real accelerator the same engine replays a
+    real-signed multi-epoch history through the fused device pipeline
+    twice — COLD (no warm-ahead: every valset boundary pays its table
+    build inside the verify path) vs WARMED (epoch tables built ahead
+    of the replay cursor) — and the headline is warmed sigs/s."""
+    import tempfile
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return _cfg18_host_machinery()
+
+    from cometbft_tpu.blocksync.pipeline import make_stream_verifier
+    from cometbft_tpu.verifyplane.warmer import TableWarmer
+
+    machinery = _cfg18_machinery()
+    items, vals_at = _catchup_history(n_blocks, n_vals, epoch_len)
+    total_sigs = n_blocks * n_vals
+
+    def run(warm_ahead):
+        with tempfile.TemporaryDirectory() as td:
+            from cometbft_tpu.blocksync.catchup import CatchupEngine
+
+            state = _ReplayState("cfg18-chain", 0, vals_at(1),
+                                 vals_at(2))
+
+            def apply_fn(st, blk, commit):
+                h = blk.header.height
+                return _ReplayState(st.chain_id, h, vals_at(h + 1),
+                                    vals_at(h + 2))
+
+            warmer = TableWarmer()
+            warmer.start()
+            try:
+                eng = CatchupEngine(
+                    _HistorySource(items), state, apply_fn=apply_fn,
+                    verifier=make_stream_verifier(),
+                    cursor_path=os.path.join(td, "cursor.json"),
+                    warm_ahead=warm_ahead, warmer=warmer)
+                t = _now_ms()
+                eng.run()
+                wall_ms = _now_ms() - t
+                return wall_ms, eng.ledger
+            finally:
+                warmer.stop()
+
+    cold_ms, _ = run(warm_ahead=False)
+    warm_ms, led = run(warm_ahead=True)
+    boundary_recs = [r for r in led.records() if r["boundary"]]
+    return {
+        "metric": "cfg18 catch-up firehose warmed replay",
+        "value": round(total_sigs / max(warm_ms, 1e-9) * 1000.0, 1),
+        "unit": "sigs/s",
+        "vs_baseline": None,
+        "extra": {
+            "blocks": n_blocks,
+            "sigs": total_sigs,
+            "cold_ms": round(cold_ms, 3),
+            "warm_ms": round(warm_ms, 3),
+            "cold_vs_warm_speedup": round(
+                cold_ms / max(warm_ms, 1e-9), 3),
+            "boundaries": led.counters["boundaries"],
+            "warm_requests": led.counters["warm_requests"],
+            "boundary_verify_ms": [r["verify_ms"]
+                                   for r in boundary_recs],
+            "machinery": {k: v for k, v in machinery.items()
+                          if k != "summary"},
+        },
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
@@ -2812,7 +3230,8 @@ SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg14_smoke", smoke_peer_ledger),
                  ("cfg15_smoke", smoke_device_observatory),
                  ("cfg16_smoke", smoke_controller),
-                 ("cfg17_smoke", smoke_tenants)]
+                 ("cfg17_smoke", smoke_tenants),
+                 ("cfg18_smoke", smoke_catchup)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -2828,7 +3247,8 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg11", cfg11_sharded_tally),
                 ("cfg12", cfg12_pipelined), ("cfg13", cfg13_churn),
                 ("cfg15", cfg15_device), ("cfg16", cfg16_controller),
-                ("cfg17", cfg17_tenants)]
+                ("cfg17", cfg17_tenants),
+                ("cfg18", cfg18_catchup)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 

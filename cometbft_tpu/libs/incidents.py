@@ -38,6 +38,12 @@ instants and the snapshots replay byte-identically):
     inside ``window_s``: gossip is backing up, so votes are about to
     arrive late everywhere — the snapshot freezes the peer-ledger tail
     naming WHICH peers' queues are starving.
+  * ``catchup_stall`` — a catch-up replay is ACTIVE but its ledger has
+    not advanced for ``catchup_stall_s`` (blocksync/catchup.py notes
+    progress per flush): the firehose is wedged — a hung history
+    source, a dead verifier, or a donor that stopped serving — and the
+    snapshot freezes the catch-up ledger tail showing exactly where
+    the cursor froze.
   * ``forced``        — the ``incidents.force`` failpoint fired (tests
     and drills; arm ``incidents.force=raise*1``).
 
@@ -62,7 +68,8 @@ fp.register("incidents.force",
 INCIDENT_CAPACITY = 32
 
 TRIGGERS = ("commit_stall", "round_escalation", "breaker_flap",
-            "shed_storm", "peer_starvation", "compile_storm", "forced")
+            "shed_storm", "peer_starvation", "compile_storm",
+            "catchup_stall", "forced")
 
 
 class IncidentRecorder:
@@ -74,6 +81,7 @@ class IncidentRecorder:
                  round_limit: int = 4, breaker_flaps: int = 4,
                  shed_storm: int = 256, peer_starvation: int = 64,
                  compile_storm: int = 3,
+                 catchup_stall_s: float = 30.0,
                  window_s: float = 10.0,
                  cooldown_s: float = 30.0,
                  capacity: int = INCIDENT_CAPACITY):
@@ -83,6 +91,7 @@ class IncidentRecorder:
         self.shed_storm = int(shed_storm)
         self.peer_starvation = int(peer_starvation)
         self.compile_storm = int(compile_storm)
+        self.catchup_stall_s = float(catchup_stall_s)
         self.window_s = float(window_s)
         self.cooldown_s = float(cooldown_s)
         self._ring: deque = deque(maxlen=max(4, int(capacity)))
@@ -101,6 +110,9 @@ class IncidentRecorder:
         self._peer_win = (0, 0)
         # compile-storm window: (window start ns, steady compiles since)
         self._comp_win = (0, 0)
+        # catch-up stall watch: active flag + last ledger-progress ns
+        self._catchup_active = False
+        self._last_catchup_ns = 0
         self._fingerprint: Optional[dict] = None
         # real-clock watchdog ticker (production only): a quorumless
         # partition wedges the step machine with NO transitions — the
@@ -126,6 +138,7 @@ class IncidentRecorder:
                 "shed_storm": self.shed_storm,
                 "peer_starvation": self.peer_starvation,
                 "compile_storm": self.compile_storm,
+                "catchup_stall_s": self.catchup_stall_s,
                 "window_s": self.window_s,
                 "cooldown_s": self.cooldown_s}
 
@@ -175,6 +188,13 @@ class IncidentRecorder:
                 start = t
             self._comp_win = (start, count + n)
 
+    def note_catchup(self, active: bool = True) -> None:
+        """Catch-up replay progress (blocksync/catchup.py): each flush
+        re-arms the stall watch; ``active=False`` disarms it (run done
+        or failed — a node that STOPPED catching up is not stalled)."""
+        self._catchup_active = bool(active)
+        self._last_catchup_ns = tracing.monotonic_ns()
+
     def poke(self, height: int = 0, round_: int = 0) -> None:
         """Evaluate every trigger. Called on each consensus step
         transition — cheap when nothing is wrong: a clock read and a
@@ -186,6 +206,7 @@ class IncidentRecorder:
             # toggle): every armed window is garbage — re-arm
             self._gen = gen
             self._last_commit_ns = now
+            self._last_catchup_ns = now
             with self._lock:
                 self._brk_win = (0, -1)
                 self._shed_win = (0, 0)
@@ -208,6 +229,14 @@ class IncidentRecorder:
                 {"stalled_s": round(
                     (now - self._last_commit_ns) / 1e9, 3),
                  "limit_s": self.commit_stall_s})
+        if self._catchup_active and self.catchup_stall_s > 0 and \
+                self._last_catchup_ns and \
+                now - self._last_catchup_ns > self.catchup_stall_s * 1e9:
+            self._fire(
+                "catchup_stall", now, height, round_,
+                {"stalled_s": round(
+                    (now - self._last_catchup_ns) / 1e9, 3),
+                 "limit_s": self.catchup_stall_s})
         self._check_breaker(now, height, round_)
         self._check_sheds(now, height, round_)
         self._check_peer_stalls(now, height, round_)
@@ -376,6 +405,7 @@ class IncidentRecorder:
             "peer_tail": [],
             "device_tail": [],
             "controller_tail": [],
+            "catchup_tail": [],
             "trace_tail": tracing.tail(24),
             "counters": self._counters(),
             "fingerprint": self._fingerprint,
@@ -416,6 +446,14 @@ class IncidentRecorder:
                 # the snapshot: did the loop react before the trigger,
                 # and in which direction?
                 snap["controller_tail"] = ctl.controller_tail(8)
+            except Exception:  # noqa: BLE001
+                pass
+        cu = sys.modules.get("cometbft_tpu.blocksync.catchup")
+        if cu is not None:
+            try:
+                # a catchup_stall's tail shows exactly where the replay
+                # cursor froze (last flushes before the wedge)
+                snap["catchup_tail"] = cu.ledger_tail(8)
             except Exception:  # noqa: BLE001
                 pass
         return snap
@@ -553,6 +591,10 @@ def note_peer_stall(n: int = 1) -> None:
 
 def note_compile(n: int = 1) -> None:
     _RECORDER.note_compile(n)
+
+
+def note_catchup(active: bool = True) -> None:
+    _RECORDER.note_catchup(active)
 
 
 def dump_incidents() -> dict:

@@ -579,6 +579,37 @@ class NodeMetrics:
             "Bytes of cached valset tables attributed per tenant "
             "chain through the registry's owner map (label tenant; "
             "unowned tables fall to tenant=\"default\")")
+        # archival bootstrap plane (statesync/stats.py): process-global
+        # counters bumped on the fetch/apply/serve seams, sampled at
+        # scrape time like the other push-less subsystems
+        self.statesync_chunks = r.counter(
+            "statesync", "chunks_total",
+            "Statesync chunks by disposition "
+            "(kind=fetched|applied|served|shed: fetched/applied on "
+            "the restoring side, served/shed on the donor — sheds are "
+            "EXPLICIT retry-hinted serve-gate verdicts, never silent "
+            "drops)")
+        self.statesync_fetch_timeouts = r.counter(
+            "statesync", "fetch_timeouts_total",
+            "Chunk waits that expired on the applier side before any "
+            "provider delivered (each reclaims the hung slot for "
+            "re-request from another provider)")
+        self.statesync_providers = r.counter(
+            "statesync", "providers_total",
+            "Chunk provider lifecycle events "
+            "(kind=punished|dropped: punished counts failure strikes, "
+            "dropped counts providers banned at the strike limit)")
+        self.statesync_retry_rounds = r.counter(
+            "statesync", "retry_snapshot_rounds_total",
+            "RETRY_SNAPSHOT rounds — the app rejected a restored "
+            "snapshot's content and the chunk sequence restarted with "
+            "the suspect chunks refetched")
+        self.statesync_snapshots = r.counter(
+            "statesync", "snapshots_total",
+            "Snapshot lifecycle events "
+            "(kind=offered|restored|served|shed: offered/restored on "
+            "the restoring side, served/shed snapshot listings on the "
+            "donor's serve gate)")
 
     def _sample(self) -> None:
         """Scrape-time refresh of the push-less internals. Modules that
@@ -851,6 +882,29 @@ class NodeMetrics:
                 for name, slot in reg.residency_by_tenant().items():
                     self.tenant_resident.set(float(slot["bytes"]),
                                              tenant=name)
+        except Exception:  # noqa: BLE001 - scrape must never fail
+            pass
+        try:
+            # archival bootstrap plane (module-loaded-only: a node that
+            # never statesync'd or served a snapshot pays nothing)
+            st = sys.modules.get("cometbft_tpu.statesync.stats")
+            if st is not None:
+                c = st.stats()
+                for kind in ("fetched", "applied", "served", "shed"):
+                    self.statesync_chunks._set(
+                        (("kind", kind),), float(c["chunks_" + kind]))
+                self.statesync_fetch_timeouts._set(
+                    (), float(c["fetch_timeouts"]))
+                for kind in ("punished", "dropped"):
+                    self.statesync_providers._set(
+                        (("kind", kind),),
+                        float(c["providers_" + kind]))
+                self.statesync_retry_rounds._set(
+                    (), float(c["retry_snapshot_rounds"]))
+                for kind in ("offered", "restored", "served", "shed"):
+                    self.statesync_snapshots._set(
+                        (("kind", kind),),
+                        float(c["snapshots_" + kind]))
         except Exception:  # noqa: BLE001 - scrape must never fail
             pass
         try:

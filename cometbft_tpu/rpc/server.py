@@ -662,6 +662,19 @@ class Routes:
 
         return vtenants.dump_tenants()
 
+    def dump_catchup(self):
+        """The catch-up firehose's always-on ledger
+        (blocksync/catchup.py): one record per fused verify+apply
+        flush — heights covered, signatures verified, read/verify/
+        apply time, valset-boundary and warm-ahead flags, resume-skip
+        counts — plus the cumulative counters and a windowed
+        blocks/sec + sigs/sec summary (also served as GET
+        /dump_catchup). The _LAST fallback serves post-mortem reads
+        after the replay finished, like every other dump route."""
+        from cometbft_tpu.blocksync import catchup
+
+        return catchup.dump_catchup()
+
     # -- light-client gateway (cometbft_tpu.lightgate; config
     # [lightgate] mounts it on the node) -------------------------------------
 
@@ -752,7 +765,7 @@ _ROUTES = [
     "unconfirmed_txs", "num_unconfirmed_txs", "tx", "tx_search",
     "block_search", "dump_traces", "dump_flushes", "dump_heights",
     "dump_incidents", "dump_peers", "dump_devices", "dump_controller",
-    "dump_tenants",
+    "dump_tenants", "dump_catchup",
     "lightgate_verify", "lightgate_headers", "lightgate_status",
 ]
 
@@ -874,7 +887,8 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path in ("/dump_traces", "/dump_flushes",
                         "/dump_heights", "/dump_incidents",
                         "/dump_peers", "/dump_devices",
-                        "/dump_controller", "/dump_tenants"):
+                        "/dump_controller", "/dump_tenants",
+                        "/dump_catchup"):
             self._send_json(getattr(self.routes, url.path[1:])())
             return
         if url.path.startswith("/debug/pprof"):

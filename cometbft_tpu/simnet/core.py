@@ -251,8 +251,15 @@ class SimNode:
         from cometbft_tpu.privval.file_pv import FilePV
 
         with self.net._node_scope(self):
+            # apps that persist (the bootstrap soak's KV-with-snapshots)
+            # get the node's home dir so a restart reopens THEIR state;
+            # plain factories (KVStoreApplication) take no kwargs
+            try:
+                app = self.app_factory(home=self.home)
+            except TypeError:
+                app = self.app_factory()
             self.node = Node(
-                self.app_factory(), self.net.geneses[self.group].copy(),
+                app, self.net.geneses[self.group].copy(),
                 privval=FilePV(self.priv), home=self.home,
                 broadcast=self._broadcast, timeouts=self.net.timeouts,
             )

@@ -19,7 +19,25 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.statesync import stats as ss_stats
+
 _log = logging.getLogger(__name__)
+
+fp.register("statesync.fetch",
+            "statesync chunk fetch (per provider worker, before the "
+            "transport call) — raise/flake fault a provider without "
+            "touching the others")
+
+
+def _mono() -> float:
+    """The LEDGER clock in seconds: virtual under the simnet's
+    installed module clock, perf_counter otherwise. Chunk request ages
+    and applier deadlines used raw ``time.monotonic()`` before PR 18,
+    which made the simnet bootstrap scenario non-replayable — the same
+    wall-clock-in-a-deadline bug PR 7 fixed for BULK sheds."""
+    return tracing.monotonic_ns() / 1e9
 
 # provider is dropped after this many failures (timeout, None, or a
 # chunk the app rejected) — syncer.go bans the peer outright
@@ -63,7 +81,7 @@ class ChunkQueue:
             for i in range(self.n):
                 if self._status[i] == PENDING:
                     self._status[i] = REQUESTED
-                    self._req_at[i] = time.monotonic()
+                    self._req_at[i] = _mono()
                     return i
             return None
 
@@ -71,7 +89,7 @@ class ChunkQueue:
         """REQUESTED slots older than max_age back to PENDING — a hung
         provider must not pin a slot forever (the chunkTimeout
         re-request of syncer.go:415). Returns how many were reclaimed."""
-        now = time.monotonic()
+        now = _mono()
         n = 0
         with self._cond:
             for i in range(self.n):
@@ -97,6 +115,7 @@ class ChunkQueue:
             self._data[i] = data
             self._sender[i] = sender
             self._status[i] = RECEIVED
+            ss_stats.bump("chunks_fetched")
             self._cond.notify_all()
             return True
 
@@ -125,10 +144,10 @@ class ChunkQueue:
 
     def wait_for(self, i: int, timeout: float) -> Optional[bytes]:
         """Block until chunk i is RECEIVED (the applier side)."""
-        deadline = time.monotonic() + timeout
+        deadline = _mono() + timeout
         with self._cond:
             while self._status[i] != RECEIVED:
-                left = deadline - time.monotonic()
+                left = deadline - _mono()
                 if left <= 0:
                     return None
                 self._cond.wait(left)
@@ -169,11 +188,13 @@ class ChunkFetcher:
         (the syncer calls this for rejected chunks too)."""
         if provider_id is None:
             return
+        ss_stats.bump("providers_punished")
         with self._lock:
             self.failures[provider_id] = self.failures.get(
                 provider_id, 0) + 1
             if self.failures[provider_id] >= MAX_PROVIDER_FAILURES:
                 if self.providers.pop(provider_id, None) is not None:
+                    ss_stats.bump("providers_dropped")
                     _log.warning("statesync: dropping provider %s",
                                  provider_id)
 
@@ -192,6 +213,7 @@ class ChunkFetcher:
                 time.sleep(0.05)  # nothing pending right now
                 continue
             try:
+                fp.fail_point("statesync.fetch")
                 data = fetch(i)
             except Exception as e:  # noqa: BLE001 - provider transport
                 _log.warning("statesync: provider %s chunk %d: %s",

@@ -14,12 +14,12 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
-from dataclasses import replace
 from typing import Callable, Dict, List, Optional
 
 from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.state.state import State
+from cometbft_tpu.statesync import stats as ss_stats
 from cometbft_tpu.types.params import ConsensusParams
 
 _log = logging.getLogger(__name__)
@@ -119,7 +119,10 @@ class Syncer:
     def sync_any(self, discovery_time: float = 5.0) -> State:
         """Try the best discovered snapshot; on failure fall through to
         the next (syncer.go SyncAny retry loop)."""
-        deadline = time.time() + discovery_time
+        # the discovery deadline ages on the LEDGER clock (virtual
+        # under simnet), not wall time — a wall-clock deadline here was
+        # the PR 18 satellite bug that made bootstrap replays diverge
+        deadline = tracing.monotonic_ns() + discovery_time * 1e9
         attempts: Dict[tuple, int] = {}
         while True:
             with self._lock:
@@ -146,7 +149,7 @@ class Syncer:
                     if attempts[key] >= 3:
                         with self._lock:
                             self._snapshots.pop(key, None)
-            if time.time() > deadline:
+            if tracing.monotonic_ns() > deadline:
                 raise StateSyncError(
                     "no usable snapshot discovered in time"
                 )
@@ -176,6 +179,7 @@ class Syncer:
             if chunk is None:
                 # a hung fetch must not pin its slot forever
                 queue.reclaim_expired(self.chunk_timeout)
+                ss_stats.bump("fetch_timeouts")
                 timeouts += 1
                 if not fetcher.has_providers() or timeouts > max_timeouts:
                     raise StateSyncError(
@@ -194,6 +198,7 @@ class Syncer:
                 fetcher.punish(s)
                 fetcher.punish(s)  # named rejection = instant drop
             if resp.result == abci.APPLY_CHUNK_ACCEPT:
+                ss_stats.bump("chunks_applied")
                 i += 1
                 retries = 0
                 continue
@@ -205,6 +210,7 @@ class Syncer:
                     raise StateSyncError(f"app rejected chunk {i}")
                 continue
             if resp.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                ss_stats.bump("retry_snapshot_rounds")
                 rounds += 1
                 if rounds > 3:
                     self._clear_cache(snapshot)
@@ -240,6 +246,7 @@ class Syncer:
         state = self.state_provider.state_at(snapshot.height)
         if not self.app.offer_snapshot(snapshot):
             raise StateSyncError("app rejected snapshot offer")
+        ss_stats.bump("snapshots_offered")
         cache = None
         if self.cache_dir:
             cache = os.path.join(
@@ -265,4 +272,5 @@ class Syncer:
             raise StateSyncError(
                 "restored app hash does not match trusted header"
             )
+        ss_stats.bump("snapshots_restored")
         return state

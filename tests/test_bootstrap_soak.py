@@ -1,0 +1,431 @@
+"""Archival bootstrap chaos soak (ISSUE 18 acceptance).
+
+A four-validator simnet loses node 3's machine entirely (halt + home
+wipe) while the three donors keep committing under a signed flood and
+a quorum-killing partition. The lost node then bootstraps through the
+archival plane — chunked merkle-verified snapshot serving behind the
+ServeGate, then the catch-up firehose replaying the donor's block
+store through the REAL execution stack into the node's own home dir —
+and a plain simnet restart brings it up live at the donors' tip:
+
+  * the donor side never sheds CONSENSUS work: every gate verdict
+    lands on serving traffic, with an explicit retry hint the
+    bootstrapping peer honors on the virtual clock;
+  * the catch-up run is killed mid-replay by a failpoint and resumed
+    from the persisted cursor, re-verifying ZERO already-verified
+    blocks;
+  * the whole thing — commit hashes, flood verdicts, serve sheds, and
+    the catch-up ledger including its timestamps — replays
+    byte-identically from (seed, schedule), because the simnet's
+    virtual clock stays installed across the bootstrap phase.
+
+Budget discipline follows test_tenants_soak.py: the two expensive runs
+are built once in a module-scoped lazy cache and shared across tests.
+"""
+import hashlib
+import json
+import os
+import shutil
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync import catchup as cu
+from cometbft_tpu.blocksync.catchup import (
+    CatchupEngine,
+    CatchupLedger,
+    HostCommitVerifier,
+    StoreHistorySource,
+)
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.simnet import Simnet
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, StateStore
+from cometbft_tpu.statesync import stats as ss_stats
+from cometbft_tpu.statesync.chunks import ChunkQueue
+from cometbft_tpu.statesync.snapshots import (
+    ServeGate,
+    SnapshotArchive,
+    SnapshotServeOverloaded,
+    proof_doc,
+    verify_chunk,
+)
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+
+pytestmark = pytest.mark.simnet
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+N_NODES = 4
+SEED = 7718
+H1 = 4  # phase-A history depth: enough for interval-2 app snapshots
+
+
+class _PersistentKV(KVStoreApplication):
+    """KVStore that survives its process: state persists to the node's
+    home dir on every commit, so a simnet restart() reopens the app the
+    bootstrap plane restored instead of a blank one. Snapshots every 2
+    heights make every node a statesync donor."""
+
+    def __init__(self, home=None):
+        super().__init__()
+        self._path = os.path.join(home, "app_state.json") if home else None
+        self.enable_snapshots(2)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as f:
+                doc = json.load(f)
+            self.state = {bytes.fromhex(k): bytes.fromhex(v)
+                          for k, v in doc["state"].items()}
+            self.height = doc["height"]
+            self.app_hash = bytes.fromhex(doc["app_hash"])
+            self.staged = dict(self.state)
+
+    def _save_disk(self):
+        if self._path is None:
+            return
+        doc = {"height": self.height, "app_hash": self.app_hash.hex(),
+               "state": {k.hex(): v.hex()
+                         for k, v in self.state.items()}}
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._path)
+
+    def commit(self):
+        rc = super().commit()
+        self._save_disk()
+        return rc
+
+    def apply_snapshot_chunk(self, index, chunk, sender):
+        rc = super().apply_snapshot_chunk(index, chunk, sender)
+        if rc is True and getattr(self, "_restore", None) is None:
+            self._save_disk()  # restore complete
+        return rc
+
+
+class _CountingVerifier(HostCommitVerifier):
+    def __init__(self):
+        self.heights = []
+
+    def verify(self, jobs):
+        self.heights.extend(j.height for j in jobs)
+        return super().verify(jobs)
+
+
+def _state_at(donor, s: int, app_hash: bytes) -> State:
+    """stateprovider.go State from the donor's stores instead of a
+    light client (syncer.LightStateProvider.state_at is the wire-level
+    twin): valsets with their REAL proposer priorities from the
+    per-height table, the commit's own BlockID (real PartSetHeader),
+    and the restored app hash cross-checked below against header S+1."""
+    bs, ss = donor.block_store, donor.state_store
+    blk = bs.load_block(s)
+    nxt = bs.load_block(s + 1)
+    commit = bs.load_block_commit(s)
+    cur = ss.load_validators(s + 1)
+    live = ss.load()
+    return State(
+        chain_id=blk.header.chain_id,
+        initial_height=live.initial_height,
+        last_block_height=s,
+        last_block_id=commit.block_id,
+        last_block_time=blk.header.time,
+        validators=cur.copy(),
+        next_validators=ss.load_validators(s + 2).copy(),
+        last_validators=ss.load_validators(s).copy(),
+        last_height_validators_changed=live.last_height_validators_changed,
+        consensus_params=live.consensus_params,
+        app_hash=app_hash,
+        last_results_hash=nxt.header.last_results_hash,
+    )
+
+
+def _bootstrap_node3(net, node3):
+    """The archival plane end to end, on the still-installed virtual
+    clock: gated merkle-chunked snapshot restore into node 3's wiped
+    home, then the catch-up firehose (killed once mid-replay, resumed
+    from the persisted cursor) through a real BlockExecutor into the
+    node's own block/state stores."""
+    donor = net.nodes[0].node
+    donor_app = donor.app._app  # the raw application behind the conn
+    donor_bs = donor.block_store
+    tip = donor_bs.height()
+
+    # deepest snapshot that leaves a real catch-up span behind it (the
+    # archive case — the freshest snapshot would make catch-up trivial)
+    snaps = [s for s in donor_app.list_snapshots()
+             if s.height + 5 <= tip]
+    assert snaps, "no snapshot deep enough below the donor tip"
+    kv_snap = snaps[-1]
+    s = kv_snap.height
+    blob = b"".join(donor_app._snapshots[s])
+
+    # -- serving: merkle archive behind the ServeGate -------------------
+    archive = SnapshotArchive(chunk_size=128)
+    snap2 = archive.generate(s, blob)
+    gate = ServeGate(rate_per_s=200.0, burst=2, max_peers=8)
+    sheds = []
+
+    def fetch(idx: int) -> bytes:
+        while True:
+            try:
+                gate.admit("boot-3", "chunk")
+            except SnapshotServeOverloaded as e:
+                # explicit retry-hinted verdict, honored on the sim clock
+                sheds.append(round(e.retry_after_ms, 6))
+                net.now += e.retry_after_ms / 1000.0
+                continue
+            chunk = archive.load_chunk(s, 2, idx)
+            doc = proof_doc(archive.proof_for(s, 2, idx))
+            assert verify_chunk(snap2.hash, chunk, doc), \
+                "merkle proof rejected a donor chunk"
+            ss_stats.bump("chunks_served")
+            return chunk
+
+    q = ChunkQueue(snap2.chunks,
+                   cache_dir=os.path.join(node3.home, "ss-cache"))
+    for i in range(snap2.chunks):
+        if q.wait_for(i, 0.0) is None:
+            q.add(i, fetch(i), "donor-0")
+    chunks = [q.wait_for(i, 0.0) for i in range(snap2.chunks)]
+    restored_blob_ok = b"".join(chunks) == blob
+
+    # -- app restore (kvstore's own format-1 whole-blob contract) -------
+    app3 = _PersistentKV(home=node3.home)
+    offer = abci.Snapshot(height=s, format=1, chunks=snap2.chunks,
+                          hash=hashlib.sha256(blob).digest())
+    assert app3.offer_snapshot(offer)
+    for i, c in enumerate(chunks):
+        assert app3.apply_snapshot_chunk(i, c, "donor-0")
+    nxt_hdr = donor_bs.load_block(s + 1).header
+    checks = {
+        "restored_blob_ok": restored_blob_ok,
+        # kvstore's advertised format-1 hash is the same whole-blob
+        # sha256 the offer used
+        "kv_hash_match": kv_snap.hash == offer.hash,
+        # syncer.go:458 VerifyApp — restored app hash against the
+        # next header's AppHash
+        "app_hash_vs_header": app3.app_hash == nxt_hdr.app_hash,
+        "app_height_is_snap": app3.height == s,
+    }
+
+    # -- catch-up firehose into node 3's own home stores ----------------
+    st = _state_at(donor, s, app3.app_hash)
+    bs3 = BlockStore(os.path.join(node3.home, "blockstore.db"))
+    ss3 = StateStore(os.path.join(node3.home, "state.db"))
+    ss3.save(st)
+    cursor_path = os.path.join(node3.home, "catchup-cursor.json")
+    led = CatchupLedger()
+    v1, v2 = _CountingVerifier(), _CountingVerifier()
+
+    def engine(verifier):
+        return CatchupEngine(
+            StoreHistorySource(donor_bs), st.copy(),
+            block_exec=BlockExecutor(app3, ss3), block_store=bs3,
+            verifier=verifier, cursor_path=cursor_path,
+            read_ahead=3, max_run=2, warm_ahead=False, ledger=led)
+
+    old_g, old_l = cu._GLOBAL, cu._LAST
+    try:
+        # killed mid-replay at the 4th history read...
+        fp.arm("catchup.read_ahead", "flake", 4, count=1)
+        with pytest.raises(fp.FailpointError):
+            engine(v1).run()
+        fp.disarm("catchup.read_ahead")
+        cursor_at_crash = json.loads(open(cursor_path).read())
+        # ...and resumed from the persisted cursor. The resumed engine
+        # seeds from the SAVED state (what a restarted process would
+        # load), not the in-memory one the crash abandoned.
+        st = ss3.load()
+        final = engine(v2).run()
+    finally:
+        cu._GLOBAL, cu._LAST = old_g, old_l
+        fp.disarm("catchup.read_ahead")
+
+    donor_state = donor.state_store.load()
+    state_match = {
+        "height": final.last_block_height == tip,
+        "app_hash": final.app_hash == donor_state.app_hash,
+        "block_id": final.last_block_id == donor_state.last_block_id,
+        "vals": final.validators.hash() == donor_state.validators.hash(),
+        "results": (final.last_results_hash
+                    == donor_state.last_results_hash),
+    }
+    bs3.close()
+    ss3.close()
+    return {
+        "snap_height": s, "tip": tip, "chunks": snap2.chunks,
+        "sheds": sheds, "gate_stats": gate.stats(),
+        "checks": checks, "state_match": state_match,
+        "cursor_at_crash": cursor_at_crash,
+        "cursor": json.loads(open(cursor_path).read()),
+        "verified_phase1": list(v1.heights),
+        "verified_phase2": list(v2.heights),
+        "ledger_records": led.records(),
+        "counters": dict(led.counters),
+    }
+
+
+def _run_bootstrap(basedir, seed: int = SEED):
+    ss_stats.reset()
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    set_global_plane(plane)
+    try:
+        with Simnet(N_NODES, seed=seed, basedir=str(basedir),
+                    app_factory=_PersistentKV) as sim:
+            net = sim.net
+            # phase A: build history (and donor app snapshots). The
+            # flood puts real key/value state behind the snapshots, so
+            # the serving phase moves a blob worth chunking.
+            assert sim.run(
+                [{"at": 0.2, "op": "flood", "node": 0, "rate": 30.0,
+                  "duration": 1.5, "signed": True, "size": 24}],
+                until_height=H1, max_time=60.0), \
+                "phase A never reached target height"
+            node3 = net.nodes[3]
+            node3.halt("machine lost")
+            shutil.rmtree(node3.home)
+            os.makedirs(node3.home)
+            # phase B: donors advance under signed flood + a partition
+            # that drops BOTH sides below quorum until the heal
+            t0 = net.now
+            chaos = [
+                {"at": t0 + 0.2, "op": "flood", "node": 0, "rate": 20.0,
+                 "duration": 2.0, "signed": True, "size": 24},
+                {"at": t0 + 0.5, "op": "partition",
+                 "groups": [[0, 1], [2], [3]]},
+                {"at": t0 + 1.5, "op": "heal"},
+            ]
+            assert sim.run(chaos, until_height=H1 + 7, max_time=90.0), \
+                "donors never recovered from phase-B chaos"
+            # the bootstrap itself (virtual clock still installed)
+            boot = _bootstrap_node3(net, node3)
+            # phase C: rejoin live, with fresh flood riding the donors
+            t1 = net.now
+            target = boot["tip"] + 2
+            assert sim.run(
+                [{"at": t1, "op": "restart", "node": 3},
+                 {"at": t1 + 0.2, "op": "flood", "node": 1,
+                  "rate": 10.0, "duration": 1.0, "signed": True,
+                  "size": 24}],
+                until_height=target, max_time=120.0), \
+                "restarted node never reached the live tip"
+            sim.assert_safety()
+            heights = [n.height() for n in net.nodes]
+            hashes = sim.commit_hashes()
+            flood_results = list(sim.flood_results)
+            restarts = node3.restarts
+    finally:
+        set_global_plane(None)
+        plane.stop()
+    return {
+        "boot": boot, "heights": heights, "target": target,
+        "hashes": hashes, "flood_results": flood_results,
+        "restarts": restarts, "plane_stats": plane.stats(),
+    }
+
+
+@pytest.fixture(scope="module")
+def boot_runs(tmp_path_factory):
+    cache = {}
+
+    def get(tag: str):
+        if tag not in cache:
+            cache[tag] = _run_bootstrap(
+                tmp_path_factory.mktemp(f"boot-{tag}"))
+        return cache[tag]
+
+    return get
+
+
+def test_killed_node_bootstraps_to_live(boot_runs):
+    """statesync -> catch-up -> live: the wiped node restores the
+    donor snapshot through the merkle plane, replays to the donor tip
+    through the real execution stack, and then COMMITS with the pack —
+    its post-restart height clears the pre-bootstrap tip."""
+    run = boot_runs("a")
+    boot = run["boot"]
+    assert all(boot["checks"].values()), boot["checks"]
+    assert all(boot["state_match"].values()), boot["state_match"]
+    assert boot["tip"] - boot["snap_height"] >= 3, \
+        "catch-up span too short to mean anything"
+    assert run["restarts"] == 1
+    # every node, including the bootstrapped one, is at/past target
+    assert all(h >= run["target"] for h in run["heights"]), \
+        run["heights"]
+    # the bootstrapped node COMMITTED live blocks past the catch-up
+    # tip, and agrees with donor 0 wherever their histories overlap
+    h0, h3 = run["hashes"][0], run["hashes"][3]
+    assert any(h > boot["tip"] for h in h3), \
+        "node 3 never committed a live block"
+    common = set(h0) & set(h3)
+    assert common and all(h0[h] == h3[h] for h in common)
+
+
+def test_donor_serving_sheds_are_explicit_and_consensus_clean(
+        boot_runs):
+    """The overload contract on the serving plane: the bootstrap storm
+    is shed with retry hints (which the peer honors and completes),
+    while the donors' CONSENSUS lane records ZERO sheds and every
+    flood verdict is an explicit code — nothing is silently dropped."""
+    run = boot_runs("a")
+    boot = run["boot"]
+    assert boot["sheds"], "gate never shed: storm too small to prove " \
+        "the contract"
+    assert all(ms > 0 for ms in boot["sheds"])
+    gs = boot["gate_stats"]
+    assert gs["sheds"] == len(boot["sheds"])
+    # every chunk was eventually served despite the sheds
+    assert gs["served"] == boot["chunks"]
+    assert run["plane_stats"]["sheds"]["consensus"] == 0
+    assert run["flood_results"], "flood never fired"
+    assert all(r["code"] is not None for r in run["flood_results"])
+
+
+def test_catchup_resumes_mid_bootstrap_reverifying_zero(boot_runs):
+    """The mid-replay kill left a persisted cursor; the resumed engine
+    re-verified ZERO blocks the first pass already verified, and the
+    ledger carries the resume."""
+    run = boot_runs("a")
+    boot = run["boot"]
+    crash = boot["cursor_at_crash"]
+    assert crash["verified"] > boot["snap_height"], \
+        "crash landed before any verification — arm later"
+    assert crash["verified"] < boot["tip"], "crash landed after the tip"
+    overlap = set(boot["verified_phase1"]) & set(boot["verified_phase2"])
+    assert overlap == set(), overlap
+    assert boot["verified_phase2"], "resume verified nothing"
+    assert min(boot["verified_phase2"]) == crash["verified"] + 1
+    assert boot["counters"]["resumes"] == 1
+    assert boot["cursor"]["applied"] == boot["tip"]
+    # both passes record into ONE ledger, and together they applied
+    # every post-snapshot block exactly once
+    applied = sum(r["blocks"] for r in boot["ledger_records"])
+    assert applied == boot["tip"] - boot["snap_height"]
+    assert applied == boot["counters"]["blocks_applied"]
+
+
+def test_bootstrap_replays_byte_identical(boot_runs):
+    """Same (seed, schedule) -> the SAME run: commit hashes, flood
+    verdicts, serve sheds, and the catch-up ledger — including its
+    virtual-clock timestamps — are equal structure-for-structure."""
+    a, b = boot_runs("a"), boot_runs("b")
+    assert a["hashes"] == b["hashes"]
+    assert a["heights"] == b["heights"]
+    assert a["flood_results"] == b["flood_results"]
+    assert a["boot"]["sheds"] == b["boot"]["sheds"]
+    assert a["boot"]["gate_stats"] == b["boot"]["gate_stats"]
+    assert a["boot"]["ledger_records"] == b["boot"]["ledger_records"]
+    assert a["boot"]["counters"] == b["boot"]["counters"]
+    assert a["boot"]["cursor_at_crash"] == b["boot"]["cursor_at_crash"]
+    assert a["boot"]["verified_phase1"] == b["boot"]["verified_phase1"]
+    assert a["boot"]["verified_phase2"] == b["boot"]["verified_phase2"]

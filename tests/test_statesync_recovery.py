@@ -1,0 +1,343 @@
+"""Bootstrap-plane recovery + serving-contract tests (ISSUE 18).
+
+The restore side: a donor cut down mid-restore (the statesync.fetch
+failpoint, kill-at-every-position matrix in test_wal_recovery.py's
+style) leaves its chunks in the cache dir, and the restarted sync
+refetches ONLY what the cache is missing. The serving side: the
+ServeGate sheds over-budget peers with explicit retry-hinted verdicts
+on the ledger clock, the p2p reactor turns those verdicts into
+``chunk_shed`` messages the fetching peer honors as backoff (not
+punishment), served chunks carry merkle inclusion proofs the client
+verifies on arrival, and the snapshot.serve failpoint faults the
+serving seam without touching anything else.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import failpoints as fp
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.statesync import stats as ss_stats
+from cometbft_tpu.statesync.p2p_reactor import (
+    CHUNK_CHANNEL, SNAPSHOT_CHANNEL, StatesyncP2PReactor)
+from cometbft_tpu.statesync.snapshots import (
+    ServeGate, SnapshotArchive, SnapshotServeOverloaded, proof_doc,
+    verify_chunk)
+from cometbft_tpu.statesync.syncer import StateSyncError, Syncer
+
+N_CHUNKS = 6
+
+
+class _ChunkApp:
+    """Minimal restoring app: accepts chunks in order; info() reports
+    the snapshot height + blob hash only once every chunk landed."""
+
+    def __init__(self, snapshot, blob_hash):
+        self.snapshot = snapshot
+        self.blob_hash = blob_hash
+        self.applied = []
+
+    def offer_snapshot(self, snapshot):
+        return True
+
+    def apply_snapshot_chunk(self, idx, chunk, sender):
+        self.applied.append(idx)
+        return True
+
+    def info(self, req):
+        done = len(self.applied) >= self.snapshot.chunks
+        return abci.ResponseInfo(
+            last_block_height=self.snapshot.height if done else 0,
+            last_block_app_hash=self.blob_hash if done else b"",
+        )
+
+
+class _Provider:
+    def __init__(self, app_hash, height):
+        self.app_hash = app_hash
+        self.height = height
+
+    def state_at(self, height):
+        class _St:
+            pass
+
+        st = _St()
+        st.app_hash = self.app_hash
+        st.last_block_height = height
+        return st
+
+
+def _archive_snapshot(n_chunks=N_CHUNKS):
+    """A merkle-rooted archive snapshot with n distinct 1KiB chunks."""
+    arch = SnapshotArchive(chunk_size=1024)
+    blob = b"".join(bytes([i]) * 1024 for i in range(n_chunks))
+    snap = arch.generate(7, blob)
+    assert snap.chunks == n_chunks
+    return arch, snap, blob
+
+
+def _restore(snap, fetch, cache_dir, chunk_timeout=0.3):
+    app = _ChunkApp(snap, b"blob-ok")
+    syncer = Syncer(app, _Provider(b"blob-ok", snap.height),
+                    chunk_timeout=chunk_timeout, cache_dir=cache_dir)
+    syncer.add_snapshot(snap, fetch, provider_id="donor")
+    return syncer.sync_any(discovery_time=0.1), app
+
+
+def test_kill_at_every_fetch_resumes_from_cache(tmp_path, monkeypatch):
+    """Matrix over the statesync.fetch seam: kill the donor at EVERY
+    fetch position (drop limit 1, so the k-th fetch is lethal and
+    exactly k-1 chunks made it to the cache), then restart the restore
+    over the same cache dir — the second run must refetch ONLY the
+    chunks the first run never cached."""
+    from cometbft_tpu.statesync import chunks as chunks_mod
+
+    arch, snap, _ = _archive_snapshot()
+    for k in range(1, N_CHUNKS + 1):
+        cache = str(tmp_path / f"cache-{k}")
+        served1 = []
+
+        def fetch1(i):
+            data = arch.load_chunk(snap.height, snap.format, i)
+            served1.append(i)
+            return data
+
+        monkeypatch.setattr(chunks_mod, "MAX_PROVIDER_FAILURES", 1)
+        fp.arm("statesync.fetch", "flake", k, count=1)
+        try:
+            with pytest.raises(StateSyncError):
+                _restore(snap, fetch1, cache)
+        finally:
+            fp.disarm("statesync.fetch")
+            monkeypatch.undo()
+        assert len(served1) == k - 1, f"k={k}: died at the wrong fetch"
+        cached = set()
+        for sub in os.listdir(cache):
+            for f in os.listdir(os.path.join(cache, sub)):
+                cached.add(int(f.split("-")[1]))
+        assert cached == set(served1), f"k={k}: cache != served"
+        assert len(cached) < N_CHUNKS  # it really did die mid-restore
+
+        fetched2 = []
+
+        def fetch2(i):
+            fetched2.append(i)
+            return arch.load_chunk(snap.height, snap.format, i)
+
+        state, app = _restore(snap, fetch2, cache)
+        assert state.last_block_height == snap.height
+        assert set(app.applied) == set(range(N_CHUNKS))
+        refetched = set(fetched2) & cached
+        assert not refetched, \
+            f"k={k}: refetched cached chunks {sorted(refetched)}"
+        assert set(fetched2) == set(range(N_CHUNKS)) - cached, f"k={k}"
+
+
+def test_serve_gate_sheds_with_exact_retry_hint():
+    """Over-budget admits raise SnapshotServeOverloaded whose
+    retry_after_ms names the exact wait until the next token — on the
+    virtual clock, waiting precisely that long readmits."""
+    now = [10 ** 12]
+    tracing.set_clock(lambda: now[0])
+    try:
+        ss_stats.reset()
+        gate = ServeGate(rate_per_s=10.0, burst=2)
+        gate.admit("peer-a")
+        gate.admit("peer-a")
+        with pytest.raises(SnapshotServeOverloaded) as ei:
+            gate.admit("peer-a")
+        hint_ms = ei.value.retry_after_ms
+        assert hint_ms == pytest.approx(100.0)  # 1 token at 10/s
+        gate.admit("peer-b", kind="snapshot")  # other peers unaffected
+        # waiting 1ms short of the hint still sheds; the hint readmits
+        now[0] += int((hint_ms - 1.0) * 1e6)
+        with pytest.raises(SnapshotServeOverloaded):
+            gate.admit("peer-a")
+        now[0] += int(1e6 + (hint_ms - 1.0) * 1e6)
+        gate.admit("peer-a")
+        st = gate.stats()
+        assert st["served"] == 4 and st["sheds"] == 2
+        c = ss_stats.stats()
+        assert c["chunks_shed"] == 2 and c["snapshots_shed"] == 0
+    finally:
+        tracing.set_clock(None)
+
+
+def test_serve_gate_peer_table_is_bounded():
+    gate = ServeGate(max_peers=8)
+    for i in range(50):
+        gate.admit(f"sybil-{i}")
+    assert gate.stats()["peers"] <= 8
+
+
+class _FakePeer:
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.sent = []
+
+    def send(self, chan, msg):
+        self.sent.append((chan, json.loads(msg.decode())))
+
+
+class _FakeSwitch:
+    def __init__(self):
+        self.stopped = []
+
+    def stop_peer_for_error(self, peer, err):
+        self.stopped.append((peer.node_id, str(err)))
+
+
+def _donor_reactor(gate=None):
+    arch, snap, blob = _archive_snapshot()
+
+    class _NoSnapApp:
+        def list_snapshots(self):
+            return []
+
+    r = StatesyncP2PReactor(_NoSnapApp(), gate=gate, archive=arch)
+    r.switch = _FakeSwitch()
+    return r, snap, blob
+
+
+def test_reactor_serves_proofs_then_sheds_with_hint():
+    """Within budget a chunk_req is answered with data + a merkle
+    proof that verifies against the offer root; over budget it is
+    answered with an explicit chunk_shed carrying the retry hint —
+    never silence, never a stopped peer."""
+    r, snap, _ = _donor_reactor(gate=ServeGate(rate_per_s=8.0, burst=2))
+    peer = _FakePeer("bootstrapper")
+    for i in range(2):
+        r.receive(CHUNK_CHANNEL, peer, json.dumps(
+            {"t": "chunk_req", "h": snap.height, "f": snap.format,
+             "i": i}).encode())
+    import base64 as b64
+    for i, (chan, msg) in enumerate(peer.sent):
+        assert (chan, msg["t"]) == (CHUNK_CHANNEL, "chunk")
+        data = b64.b64decode(msg["data"])
+        assert data == bytes([i]) * 1024
+        assert verify_chunk(snap.hash, data, msg["proof"])
+    r.receive(CHUNK_CHANNEL, peer, json.dumps(
+        {"t": "chunk_req", "h": snap.height, "f": snap.format,
+         "i": 2}).encode())
+    chan, shed = peer.sent[-1]
+    assert shed["t"] == "chunk_shed" and shed["i"] == 2
+    assert shed["retry_after_ms"] > 0
+    assert r.switch.stopped == []  # a shed is a verdict, not an error
+
+
+def test_reactor_snapshot_offers_carry_merkle_root():
+    r, snap, _ = _donor_reactor()
+    peer = _FakePeer("asker")
+    r.receive(SNAPSHOT_CHANNEL, peer,
+              json.dumps({"t": "snapshots_req"}).encode())
+    offers = [m for c, m in peer.sent if m["t"] == "snapshot"]
+    assert len(offers) == 1
+    assert bytes.fromhex(offers[0]["root"]) == snap.hash
+    assert offers[0]["c"] == N_CHUNKS
+
+
+def test_snapshot_serve_failpoint_faults_the_serving_seam():
+    """snapshot.serve raising after gate admission rides the reactor's
+    malformed-message path: the requesting peer is stopped, nothing
+    else breaks, and the next request (failpoint disarmed) serves."""
+    r, snap, _ = _donor_reactor()
+    peer = _FakePeer("victim")
+    req = json.dumps({"t": "chunk_req", "h": snap.height,
+                      "f": snap.format, "i": 0}).encode()
+    fp.arm("snapshot.serve", "raise", count=1)
+    try:
+        r.receive(CHUNK_CHANNEL, peer, req)
+    finally:
+        fp.disarm("snapshot.serve")
+    assert [m["t"] for c, m in peer.sent] == []  # nothing served
+    assert len(r.switch.stopped) == 1
+    r.receive(CHUNK_CHANNEL, peer, req)
+    assert [m["t"] for c, m in peer.sent] == ["chunk"]
+
+
+def test_fetch_chunk_honors_shed_hint_then_succeeds():
+    """The client side of the shed contract: a chunk_shed answer makes
+    _fetch_chunk wait the hinted backoff and RE-REQUEST from the same
+    donor (no punish), and the retried chunk verifies against the
+    root."""
+    arch, snap, _ = _archive_snapshot()
+    r = StatesyncP2PReactor(app=None)
+    r.switch = _FakeSwitch()
+    peer = _FakePeer("donor")
+    result = []
+
+    def run():
+        result.append(r._fetch_chunk(peer, snap, 0, timeout=5.0,
+                                     root=snap.hash))
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    deadline = 50
+    while len(peer.sent) < 1 and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert peer.sent[0][1]["t"] == "chunk_req"
+    r.receive(CHUNK_CHANNEL, peer, json.dumps(
+        {"t": "chunk_shed", "h": snap.height, "f": snap.format,
+         "i": 0, "retry_after_ms": 5.0}).encode())
+    deadline = 100
+    while len(peer.sent) < 2 and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert len(peer.sent) == 2, "shed hint did not trigger a retry"
+    import base64 as b64
+    data = arch.load_chunk(snap.height, snap.format, 0)
+    r.receive(CHUNK_CHANNEL, peer, json.dumps(
+        {"t": "chunk", "h": snap.height, "f": snap.format, "i": 0,
+         "data": b64.b64encode(data).decode(),
+         "proof": proof_doc(arch.proof_for(snap.height, snap.format,
+                                           0))}).encode())
+    th.join(timeout=5.0)
+    assert result == [data]
+
+
+def test_fetch_chunk_rejects_bad_proof():
+    """A chunk that fails merkle verification against the offer root
+    returns None — the fetcher punishes exactly this sender."""
+    arch, snap, _ = _archive_snapshot()
+    r = StatesyncP2PReactor(app=None)
+    r.switch = _FakeSwitch()
+    peer = _FakePeer("liar")
+    result = []
+
+    def run():
+        result.append(r._fetch_chunk(peer, snap, 1, timeout=5.0,
+                                     root=snap.hash))
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    deadline = 50
+    while len(peer.sent) < 1 and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    import base64 as b64
+    r.receive(CHUNK_CHANNEL, peer, json.dumps(
+        {"t": "chunk", "h": snap.height, "f": snap.format, "i": 1,
+         "data": b64.b64encode(b"poison").decode(),
+         "proof": proof_doc(arch.proof_for(snap.height, snap.format,
+                                           1))}).encode())
+    th.join(timeout=5.0)
+    assert result == [None]
+
+
+def test_archive_retention_is_bounded():
+    arch = SnapshotArchive(keep=3, chunk_size=512)
+    for h in range(1, 6):
+        arch.generate(h, bytes([h]) * 2048)
+    snaps = arch.list_snapshots()
+    assert [s.height for s in snaps] == [3, 4, 5]
+    # evicted snapshots serve nothing; retained ones round-trip
+    assert arch.load_chunk(1, 2, 0) == b""
+    assert arch.proof_for(1, 2, 0) is None
+    s5 = snaps[-1]
+    blob = b"".join(arch.load_chunk(5, s5.format, i)
+                    for i in range(s5.chunks))
+    assert blob == bytes([5]) * 2048

@@ -36,7 +36,7 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 11  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 12  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
@@ -45,8 +45,8 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
                                "cfg12_smoke", "cfg13_smoke",
                                "cfg14_smoke", "cfg15_smoke",
                                "cfg16_smoke", "cfg17_smoke",
-                               "cfg2_smoke", "cfg4_smoke",
-                               "cfg6_smoke"]
+                               "cfg18_smoke", "cfg2_smoke",
+                               "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -104,6 +104,16 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     # smoke configs left in the process-global cache — the bench
     # tenants themselves must both be present with their full rows
     assert {"bench-0", "bench-1"} <= set(tn["tenants_dump"]["tenants"])
+    # the cfg18 miniature proved the catch-up firehose: mid-replay
+    # kill resumes from the persisted cursor re-verifying ZERO
+    # already-applied blocks, boundaries pre-scanned, warm-ahead
+    # fired, and the /dump_catchup document embedded for
+    # tools/catchup_report.py
+    cu = results["cfg18_smoke"]["extra"]
+    assert all(cu["checks"].values()), cu["checks"]
+    assert cu["reverified_after_resume"] == 0
+    assert cu["catchup_dump"]["records"], cu["catchup_dump"]
+    assert cu["catchup_dump"]["counters"]["resumes"] >= 1
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

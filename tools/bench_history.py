@@ -56,6 +56,14 @@ _CONTROLLER_CFGS = ("cfg16",)
 # '—' before its first recorded round, same as the other sub-rows
 _TENANT_CFGS = ("cfg17",)
 
+# cfg18 embeds the catch-up firehose figures: a "cfg18 replay" sub-row
+# tracks valset boundaries crossed vs warm-ahead requests issued (the
+# plane's one job is warming every epoch table before the replay
+# cursor reaches it) — '—' before its first recorded round. Host-only
+# machinery rounds carry the figures inside extra.machinery instead of
+# at the top level, so the sub-row falls back there.
+_CATCHUP_CFGS = ("cfg18",)
+
 
 def _cfg_key(name: str):
     if name == "headline":
@@ -165,6 +173,23 @@ def history(rounds: dict) -> dict:
                     "vs_baseline": None,
                 })
             series[f"{cfg} pod"] = tpts
+        if cfg in _CATCHUP_CFGS:
+            rpts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                src = extra if "boundaries" in extra \
+                    else (extra.get("machinery") or {})
+                bo = src.get("boundaries")
+                wr = src.get("warm_requests")
+                rpts.append({
+                    "round": tag,
+                    "value": (f"{bo}b/{wr}w"
+                              if bo is not None and wr is not None
+                              else None),
+                    "unit": "boundaries/warms",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} replay"] = rpts
         if cfg in _COMMIT_LATENCY_CFGS:
             cpts = []
             for tag in rounds:
