@@ -35,6 +35,13 @@ from cometbft_tpu.types.validator import ValidatorSet
 # across runs (one compile per signature bucket, not per run length).
 MAX_COMMITS_PER_CHUNK = 64
 
+# Device-side sign-bytes stamping for the cached chunk path (ISSUE 19):
+# ship per-row (sig, ts, flags) deltas plus ONE resident template per
+# commit height instead of full packed rows — the catch-up firehose is
+# exactly the cross-height shape the template cache amortizes. Flip off
+# to force the legacy full-row pack (the bit-live differential oracle).
+DEVICE_STAMP = True
+
 
 @dataclass
 class CommitJob:
@@ -181,6 +188,20 @@ class StreamVerifier:
         n = len(pubs)
         if any(len(s) != 64 for s in sigs):
             return None  # malformed rows: dense screen path handles
+        pos = np.asarray(row_pos, np.int64)
+        thresh = np.zeros((cap, ek.TALLY_LIMBS), np.int32)
+        thresh[:, -1] = ek.POWER_MASK  # unreachable for padded job slots
+        for j, (_, job) in enumerate(jobs):
+            thresh[j] = ek.threshold_limbs(
+                job.vals.total_voting_power() * 2 // 3
+            )[0]
+        # delta staging first: when every job stamps, the whole host
+        # pack below (SHA-512 + mod-L per row) never runs
+        pending = self._stamp_chunk(jobs, sigs, row_ts, row_job, pos,
+                                    B, cap, table, thresh)
+        if pending is not None:
+            return _Chunk(list(jobs), np.asarray(row_job),
+                          np.asarray(row_idx), pending, row_pos=pos)
         # dense native/numpy pack, then scatter to the strided layout
         packed = None
         if native.available():
@@ -205,7 +226,6 @@ class StreamVerifier:
             pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
             ry_d, rsign_d = pbd.ry, pbd.rsign
             sdig_d, hdig_d, pre_d = pbd.sdig, pbd.hdig, pbd.precheck
-        pos = np.asarray(row_pos, np.int64)
         # pinned staging: chunk arrays rotate through the verifier's
         # persistent pool so packing chunk k+1 reuses chunk k-2's memory
         pool = self._staging
@@ -224,12 +244,6 @@ class StreamVerifier:
         commit_ids = pool.get("chunk.cid", (B,), np.int32)
         for j in range(cap):
             commit_ids[j * M:(j + 1) * M] = j
-        thresh = np.zeros((cap, ek.TALLY_LIMBS), np.int32)
-        thresh[:, -1] = ek.POWER_MASK  # unreachable for padded job slots
-        for j, (_, job) in enumerate(jobs):
-            thresh[j] = ek.threshold_limbs(
-                job.vals.total_voting_power() * 2 // 3
-            )[0]
         pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
         out = pool.get("chunk.rows", ec.packed_rows_shape(B, cap),
                        np.int32)
@@ -238,6 +252,58 @@ class StreamVerifier:
         pending = ec.verify_tally_rows_cached(rows, table, cap)
         return _Chunk(list(jobs), np.asarray(row_job),
                       np.asarray(row_idx), pending, row_pos=pos)
+
+    def _stamp_chunk(self, jobs, sigs, row_ts, row_job, pos, B, cap,
+                     table, thresh):
+        """Delta staging for the cached chunk (ISSUE 19): stage only
+        (sig, ts_words, flags) per row — 80 B instead of the full
+        packed column set — and let the device stamping prologue
+        expand each row against its height's resident template
+        (tmpl_id == commit_id == the job index). Returns the pending
+        device arrays, or None when the chunk must host-pack: stamping
+        disabled, a pre-pub_raw table, more heights than the template
+        matrix holds, or timestamp words outside the staged int32
+        layout."""
+        if not DEVICE_STAMP or getattr(table, "pub_raw", None) is None:
+            return None
+        from cometbft_tpu.ops import ed25519_cached as ec
+        from cometbft_tpu.types import canonical
+
+        if len(jobs) > ec.MAX_TEMPLATE_SITES:
+            return None
+        if any(not (-2**63 <= s < 2**63 and -2**31 <= nn < 2**31)
+               for s, nn in row_ts):
+            return None
+        sites = []
+        for _, job in jobs:
+            tpl = canonical.VoteRowTemplate(
+                job.chain_id, canonical.PRECOMMIT_TYPE,
+                job.commit.height, job.commit.round,
+                job.commit.block_id)
+            sites.append(tpl.stamp_site())
+        sec_a = np.array([s for s, _ in row_ts], np.int64)
+        nan_a = np.array([nn for _, nn in row_ts], np.int64)
+        try:
+            ent = ec.template_entry(sites)
+        except Exception:  # noqa: BLE001 - oversized site: host pack
+            return None
+        pool = self._staging
+        dsig = pool.get("chunk.dsig", (B, 64), np.uint8)
+        dsig[pos] = np.frombuffer(b"".join(sigs),
+                                  np.uint8).reshape(-1, 64)
+        dts = pool.get("chunk.dts", (B, 3), np.int32)
+        dts[pos, 0] = (sec_a & 0xFFFFFFFF).astype(np.uint32) \
+            .view(np.int32)
+        dts[pos, 1] = (sec_a >> 32).astype(np.int32)
+        dts[pos, 2] = nan_a.astype(np.int32)
+        dfl = pool.get("chunk.dflags", (B,), np.int32)
+        rj = np.asarray(row_job, np.int64)
+        # live | counted | tmpl_id<<2 | cid<<10 — every packed chunk
+        # row is countable (the for_block filter already ran); dead
+        # lanes keep the pool's zero fill (live=0 -> zero row)
+        dfl[pos] = (3 | (rj << 2) | (rj << 10)).astype(np.int32)
+        return ec.verify_tally_delta_cached(dsig, dts, dfl, ent, table,
+                                            cap, thresh)
 
     def _pack_chunk(self, jobs) -> Optional[_Chunk]:
         """jobs: [(global_idx, CommitJob)] for this chunk."""

@@ -303,9 +303,10 @@ class NodeMetrics:
         )
         self.plane_h2d_bytes = r.counter(
             "verifyplane", "h2d_bytes_total",
-            "Bytes of packed signature rows staged host-to-device by "
-            "verify-plane flushes (valset tables are device-resident "
-            "and excluded)")
+            "Bytes staged host-to-device by verify-plane flushes, "
+            "split by path label: device (per-row delta buffers, "
+            "sign-bytes stamped on device) vs host (full packed rows); "
+            "valset tables are device-resident and excluded")
         # flush-ledger percentiles (PR 6): the always-on per-flush ring
         # (verifyplane.plane.FlushLedger) sampled at scrape time —
         # stage=queued|pack|flight|collect|settle, q=p50|p90|max, all

@@ -206,11 +206,19 @@ class ValsetTable:
 
     def __init__(self, tab, ok, power5, n_vals: int,
                  pubs_host: Optional[tuple] = None,
-                 powers_host: Optional[np.ndarray] = None):
+                 powers_host: Optional[np.ndarray] = None,
+                 pub_raw=None):
         self.tab = tab          # (M/128 * 8192, 128) int16, device
         self.ok = ok            # (M,) bool, device
         self.power5 = power5    # (M, POWER_LIMBS) int32, device
         self.n_vals = n_vals
+        # (M, 32) uint8 device copy of the raw pubkeys: the A operand
+        # the device stamping prologue hashes (SHA-512(R||A||msg)) when
+        # a flush ships deltas instead of packed rows. Valset data like
+        # power5 — rides the table upload, never the per-flush stage.
+        # None (pre-stamping tables, stub builders) disables the delta
+        # path for this table; fused.plan_fused falls back to host pack.
+        self.pub_raw = pub_raw
         # per-slot ACTUAL pubkey bytes + host power copy — lets
         # table_for_pubs find a near-miss cached table and compute the
         # exact (pubkey, power) delta without a device round trip.
@@ -249,6 +257,18 @@ def _powers_host(powers, padded: int) -> np.ndarray:
     return ph
 
 
+def _pub_raw(pub_bytes: Sequence[bytes], padded: int):
+    """(padded, 32) uint8 device array of the raw pubkey bytes (dead
+    and malformed slots zero). Separate from _pack_pub_arrays on
+    purpose: that helper's (ay, asign, lenok) return is aliased by the
+    shardplane test prog and must keep its arity."""
+    a = np.zeros((padded, 32), np.uint8)
+    for i, p in enumerate(pub_bytes[:padded]):
+        if len(p) == 32:
+            a[i] = np.frombuffer(p, np.uint8)
+    return jax.device_put(a)
+
+
 def _pack_pub_arrays(pub_bytes: Sequence[bytes], padded: int):
     a_raw = np.zeros((padded, 32), np.uint8)
     lenok = np.zeros(padded, np.bool_)
@@ -272,7 +292,8 @@ def build_table(pub_bytes: Sequence[bytes],
     return ValsetTable(_blocked_i16(tbl), ok,
                        _power_dev(powers, padded),
                        padded, _pubs_host(pub_bytes, padded),
-                       _powers_host(powers, padded))
+                       _powers_host(powers, padded),
+                       _pub_raw(pub_bytes, padded))
 
 
 # -- incremental update (validator-set churn) ------------------------------
@@ -384,7 +405,21 @@ def update_table(table: ValsetTable, changes,
         ph = table.powers_host.copy()
         for i, pw in pw_items:
             ph[i] = pw
-    return ValsetTable(tab, ok, power5, table.n_vals, pubs_host, ph)
+    # pub_raw is tiny (M*32 bytes vs the 2 MB/128-slot curve table), so
+    # unlike the window columns a host-side patch + re-upload is cheaper
+    # than any device scatter program
+    pr = table.pub_raw
+    if pr is not None and changes:
+        if pubs_host is not None:
+            pr = _pub_raw(pubs_host, table.n_vals)
+        else:
+            arr = np.asarray(pr).copy()
+            for i, p in changes:
+                arr[i] = (np.frombuffer(p, np.uint8)
+                          if len(p) == 32 else 0)
+            pr = jax.device_put(arr)
+    return ValsetTable(tab, ok, power5, table.n_vals, pubs_host, ph,
+                       pr)
 
 
 # The whole cache stack below (built tables, sharded tables, the two
@@ -616,14 +651,20 @@ class ShardedValsetTable:
     leaves its chip. m_shard is a table_pad bucket, which keeps the
     in-kernel `row mod M -> validator` map intact per device."""
 
-    __slots__ = ("tab", "ok", "power5", "m_shard", "n_dev")
+    __slots__ = ("tab", "ok", "power5", "m_shard", "n_dev", "pub_raw")
 
-    def __init__(self, tab, ok, power5, m_shard: int, n_dev: int):
+    def __init__(self, tab, ok, power5, m_shard: int, n_dev: int,
+                 pub_raw=None):
         self.tab = tab
         self.ok = ok
         self.power5 = power5
         self.m_shard = m_shard
         self.n_dev = n_dev
+        # (n_dev*m_shard, 32) uint8 GLOBAL array, P(axis, None): device
+        # d's slice holds its own validators' raw pubkeys, so the
+        # sharded stamping prologue hashes A = pub_raw[row mod m_shard]
+        # from purely local data. None disables delta staging.
+        self.pub_raw = pub_raw
 
 
 def shard_stride(n_vals: int, n_dev: int) -> int:
@@ -669,7 +710,7 @@ def sharded_table_for_pubs_info(pub_bytes: Sequence[bytes], powers,
     devs = list(mesh.devices.flat)
     n_dev = len(devs)
     m_s = shard_stride(len(pub_bytes), n_dev)
-    tabs, oks, p5s = [], [], []
+    tabs, oks, p5s, prs = [], [], [], []
     for d, dev in enumerate(devs):
         lo = d * m_s
         chunk = list(pub_bytes[lo:lo + m_s])
@@ -689,6 +730,9 @@ def sharded_table_for_pubs_info(pub_bytes: Sequence[bytes], powers,
         tabs.append(jax.device_put(st.tab, dev))
         oks.append(jax.device_put(st.ok, dev))
         p5s.append(jax.device_put(st.power5, dev))
+        prs.append(jax.device_put(
+            st.pub_raw if st.pub_raw is not None
+            else jnp.zeros((m_s, 32), jnp.uint8), dev))
     axis = mesh.axis_names[0]
     mk = jax.make_array_from_single_device_arrays
     blocks = m_s // 128 * ENT_BLOCK
@@ -699,6 +743,7 @@ def sharded_table_for_pubs_info(pub_bytes: Sequence[bytes], powers,
         mk((n_dev * m_s, ek.POWER_LIMBS),
            NamedSharding(mesh, P(axis, None)), p5s),
         m_s, n_dev,
+        mk((n_dev * m_s, 32), NamedSharding(mesh, P(axis, None)), prs),
     )
     with _TABLE_LOCK:
         _SHARD_CACHE.put(key, t)
@@ -1012,6 +1057,616 @@ def pack_rows_cached(pb, counted=None, commit_ids=None,
     flat = rows[V_THRESH:].reshape(-1)
     flat[: tvals.size] = tvals
     return rows
+
+
+# --------------------------------------------------------------------------
+# device-side sign-bytes stamping (delta flushes)
+# --------------------------------------------------------------------------
+#
+# A template-eligible flush ships (device-resident template, per-row
+# deltas) instead of full packed rows: 64 B signature + 12 B timestamp
+# words + 4 B flags per row, against the ~700 B/row the legacy host
+# pack stages (scatter buffers + packed rows). The prologue below
+# rebuilds the EXACT packed rows on device: LEB128-stamp the timestamp
+# varints into the canonical sign-bytes (port of
+# types/canonical.VoteRowTemplate.patch_rows), SHA-512 the
+# R || A || msg input, reduce the digest mod L, and assemble the same
+# (R, B) int32 layout pack_rows_cached builds — bit-identical by the
+# differential tests in tests/test_sign_template.py. Everything is
+# plain XLA (jnp), not Pallas: it is elementwise/gather work with no
+# reuse to tile for, and staying XLA keeps it testable on the CPU
+# tier-1 host without interpret-mode compiles.
+
+
+class TemplateEntry:
+    """Device-resident encoded stamp templates for one flush family: a
+    row per StampSite (prefix bytes, suffix bytes, timestamp tag plus
+    lengths), padded to bucketed shapes. Cached in tc.TEMPLATES under
+    the sites' content key — same BoundedLRU discipline as the valset
+    window tables (capacity >= 2, hits refresh recency, and a plan
+    holding an entry keeps its device buffers alive across an evict,
+    so the live template is never freed mid-flush)."""
+
+    __slots__ = ("key", "pre_mat", "pre_len", "suf_mat", "suf_len",
+                 "ts_tag", "n_sites", "msg_max", "nbytes")
+
+
+MAX_TEMPLATE_SITES = 256  # tmpl_id rides 8 bits of the staged flags
+
+
+def _bucket_up(n: int, q: int) -> int:
+    return -(-max(int(n), 1) // q) * q
+
+
+def template_entry(sites) -> TemplateEntry:
+    """The device template matrices for a tuple of canonical.StampSite,
+    via the bounded template cache (template_hits/template_misses in
+    table_cache_stats()). Shapes bucket — pre/suf widths to 32 bytes,
+    site count to a power of two, worst-case row length to 64 — so the
+    stamp jit's compile key is stable across heights: heights are
+    fixed-width sfixed64 in the prefix, so per-height content rides
+    the device arrays, never the shapes."""
+    sites = tuple(sites)
+    if not 0 < len(sites) <= MAX_TEMPLATE_SITES:
+        raise ValueError(
+            f"{len(sites)} stamp sites (max {MAX_TEMPLATE_SITES})")
+    key = tuple(s.key for s in sites)
+    with _TABLE_LOCK:
+        ent = tc.TEMPLATES.get(key)
+        if ent is not None:
+            _TABLE_STATS["template_hits"] += 1
+            tc.consume_warmed(("template",) + key)
+            return ent
+        _TABLE_STATS["template_misses"] += 1
+    t_pad = 1
+    while t_pad < len(sites):
+        t_pad *= 2
+    pm = _bucket_up(max(s.pre.size for s in sites), 32)
+    sm = _bucket_up(max(s.suf.size for s in sites), 32)
+    pre = np.zeros((t_pad, pm), np.uint8)
+    suf = np.zeros((t_pad, sm), np.uint8)
+    pl = np.zeros((t_pad,), np.int32)
+    sl = np.zeros((t_pad,), np.int32)
+    tg = np.zeros((t_pad,), np.int32)
+    for i, s in enumerate(sites):
+        pre[i, : s.pre.size] = s.pre
+        suf[i, : s.suf.size] = s.suf
+        pl[i] = s.pre.size
+        sl[i] = s.suf.size
+        tg[i] = s.ts_tag
+    ent = TemplateEntry()
+    ent.key = key
+    ent.pre_mat = jax.device_put(pre)
+    ent.pre_len = jax.device_put(pl)
+    ent.suf_mat = jax.device_put(suf)
+    ent.suf_len = jax.device_put(sl)
+    ent.ts_tag = jax.device_put(tg)
+    ent.n_sites = len(sites)
+    ent.msg_max = _bucket_up(max(s.max_len for s in sites), 64)
+    ent.nbytes = sum(int(a.nbytes) for a in
+                     (ent.pre_mat, ent.pre_len, ent.suf_mat,
+                      ent.suf_len, ent.ts_tag))
+    with _TABLE_LOCK:
+        tc.TEMPLATES.put(key, ent)
+    return ent
+
+
+def warm_template(sites) -> bool:
+    """The warmer's template pre-build: builds AND marks only when the
+    entry is absent (the PR 11 warm-attribution rules — a mark for an
+    entry already cached would fake a warmed_hit). Returns True when a
+    build actually happened."""
+    sites = tuple(sites)
+    key = tuple(s.key for s in sites)
+    with _TABLE_LOCK:
+        if key in tc.TEMPLATES:
+            return False
+    template_entry(sites)
+    note_warmed(("template",) + key)
+    return True
+
+
+# -- 64-bit LEB128 varints from int32 words (no jax x64 anywhere) ----------
+
+
+def _leb_pack(gs):
+    """7-bit groups (lsb first) -> (LEB128 bytes, lengths). Length =
+    last nonzero group + 1 (min 1); continuation bit on every byte
+    before the last — exactly canonical._vec_uvarint's loop."""
+    g = jnp.stack(gs, axis=1)  # (B, n)
+    n = g.shape[1]
+    idx = jnp.arange(1, n + 1, dtype=jnp.int32)
+    lens = jnp.maximum(
+        1, jnp.max(jnp.where(g != 0, idx[None, :], 0), axis=1))
+    cont = idx[None, :] < lens[:, None]
+    return g | jnp.where(cont, 0x80, 0), lens
+
+
+def _dev_uvarint64(lo, hi):
+    """(B,) int32 lo/hi words of a 64-bit two's-complement value ->
+    ((B, 10) int32 LEB128 bytes, (B,) int32 lengths)."""
+    lo = lo.astype(jnp.uint32)
+    hi = hi.astype(jnp.uint32)
+    gs = []
+    for j in range(10):
+        s = 7 * j
+        if s + 7 <= 32:
+            g = lo >> s
+        elif s < 32:
+            g = (lo >> s) | (hi << (32 - s))
+        else:
+            g = hi >> (s - 32)
+        gs.append((g & 0x7F).astype(jnp.int32))
+    return _leb_pack(gs)
+
+
+def _dev_uvarint32(v):
+    """(B,) small nonnegative int32 (the outer length prefix) ->
+    ((B, 5) bytes, (B,) lengths)."""
+    u = v.astype(jnp.uint32)
+    gs = [((u >> (7 * j)) & 0x7F).astype(jnp.int32) for j in range(5)]
+    return _leb_pack(gs)
+
+
+# -- batched SHA-512 in (hi, lo) uint32 pairs ------------------------------
+
+_SHA512_K = (
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f,
+    0xe9b5dba58189dbbc, 0x3956c25bf348b538, 0x59f111f1b605d019,
+    0x923f82a4af194f9b, 0xab1c5ed5da6d8118, 0xd807aa98a3030242,
+    0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235,
+    0xc19bf174cf692694, 0xe49b69c19ef14ad2, 0xefbe4786384f25e3,
+    0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65, 0x2de92c6f592b0275,
+    0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f,
+    0xbf597fc7beef0ee4, 0xc6e00bf33da88fc2, 0xd5a79147930aa725,
+    0x06ca6351e003826f, 0x142929670a0e6e70, 0x27b70a8546d22ffc,
+    0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6,
+    0x92722c851482353b, 0xa2bfe8a14cf10364, 0xa81a664bbc423001,
+    0xc24b8b70d0f89791, 0xc76c51a30654be30, 0xd192e819d6ef5218,
+    0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99,
+    0x34b0bcb5e19b48a8, 0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb,
+    0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3, 0x748f82ee5defb2fc,
+    0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915,
+    0xc67178f2e372532b, 0xca273eceea26619c, 0xd186b8c721c0c207,
+    0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178, 0x06f067aa72176fba,
+    0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc,
+    0x431d67c49c100d4c, 0x4cc5d4becb3e42b6, 0x597f299cfc657e2a,
+    0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+)
+_SHA512_H0 = (
+    0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b,
+    0xa54ff53a5f1d36f1, 0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+    0x1f83d9abfb41bd6b, 0x5be0cd19137e2179,
+)
+
+
+def _pair_const(vals):
+    a = np.asarray(vals, np.uint64)
+    return (np.asarray(a >> np.uint64(32), np.uint32),
+            np.asarray(a & np.uint64(0xFFFFFFFF), np.uint32))
+
+
+_SHA_K_HI, _SHA_K_LO = _pair_const(_SHA512_K)
+_SHA_H_HI, _SHA_H_LO = _pair_const(_SHA512_H0)
+
+
+def _rotr_p(h, l, n: int):
+    if n < 32:
+        return ((h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n)))
+    if n == 32:
+        return l, h
+    m = n - 32
+    return ((l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m)))
+
+
+def _shr_p(h, l, n: int):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3_p(a, b, c):
+    return a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1]
+
+
+def _add_p(a, b):
+    lo = a[1] + b[1]
+    hi = a[0] + b[0] + (lo < a[1]).astype(jnp.uint32)
+    return hi, lo
+
+
+def _sha512_blocks(data, nblk_row, nblk: int):
+    """Batched SHA-512 over (B, nblk*128) int32 byte lanes. Rows stop
+    absorbing after their own nblk_row blocks (per-row active mask) —
+    padding and bit-length bytes are already in `data`. Returns the 8
+    state words as (hi, lo) uint32 pairs. W extension and the 80
+    rounds run as fori_loops so the traced graph stays small on the
+    CPU tier-1 host."""
+    B = data.shape[0]
+    state = [(jnp.full((B,), _SHA_H_HI[i], jnp.uint32),
+              jnp.full((B,), _SHA_H_LO[i], jnp.uint32))
+             for i in range(8)]
+    k_hi = jnp.asarray(_SHA_K_HI)
+    k_lo = jnp.asarray(_SHA_K_LO)
+    for j in range(nblk):
+        blk = data[:, j * 128:(j + 1) * 128].astype(jnp.uint32)
+        wh = jnp.zeros((80, B), jnp.uint32)
+        wl = jnp.zeros((80, B), jnp.uint32)
+        for t in range(16):
+            hi = ((blk[:, 8 * t] << 24) | (blk[:, 8 * t + 1] << 16)
+                  | (blk[:, 8 * t + 2] << 8) | blk[:, 8 * t + 3])
+            lo = ((blk[:, 8 * t + 4] << 24) | (blk[:, 8 * t + 5] << 16)
+                  | (blk[:, 8 * t + 6] << 8) | blk[:, 8 * t + 7])
+            wh = wh.at[t].set(hi)
+            wl = wl.at[t].set(lo)
+
+        def w_ext(t, wp):
+            wh, wl = wp
+            x15 = (wh[t - 15], wl[t - 15])
+            x2 = (wh[t - 2], wl[t - 2])
+            s0 = _xor3_p(_rotr_p(*x15, 1), _rotr_p(*x15, 8),
+                         _shr_p(*x15, 7))
+            s1 = _xor3_p(_rotr_p(*x2, 19), _rotr_p(*x2, 61),
+                         _shr_p(*x2, 6))
+            nw = _add_p(_add_p((wh[t - 16], wl[t - 16]), s0),
+                        _add_p((wh[t - 7], wl[t - 7]), s1))
+            return wh.at[t].set(nw[0]), wl.at[t].set(nw[1])
+
+        wh, wl = jax.lax.fori_loop(16, 80, w_ext, (wh, wl))
+
+        def round_body(t, st):
+            a = (st[0], st[1])
+            b = (st[2], st[3])
+            c = (st[4], st[5])
+            d = (st[6], st[7])
+            e = (st[8], st[9])
+            f = (st[10], st[11])
+            g = (st[12], st[13])
+            h = (st[14], st[15])
+            s1 = _xor3_p(_rotr_p(*e, 14), _rotr_p(*e, 18),
+                         _rotr_p(*e, 41))
+            ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+                  (e[1] & f[1]) ^ (~e[1] & g[1]))
+            t1 = _add_p(_add_p(_add_p(h, s1), ch),
+                        _add_p((k_hi[t], k_lo[t]), (wh[t], wl[t])))
+            s0 = _xor3_p(_rotr_p(*a, 28), _rotr_p(*a, 34),
+                         _rotr_p(*a, 39))
+            maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+                   (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+            t2 = _add_p(s0, maj)
+            ne = _add_p(d, t1)
+            na = _add_p(t1, t2)
+            return (na[0], na[1], a[0], a[1], b[0], b[1], c[0], c[1],
+                    ne[0], ne[1], e[0], e[1], f[0], f[1], g[0], g[1])
+
+        init = tuple(x for p in state for x in p)
+        fin = jax.lax.fori_loop(0, 80, round_body, init)
+        act = nblk_row > j
+        nxt = []
+        for i in range(8):
+            s = _add_p(state[i], (fin[2 * i], fin[2 * i + 1]))
+            nxt.append((jnp.where(act, s[0], state[i][0]),
+                        jnp.where(act, s[1], state[i][1])))
+        state = nxt
+    return state
+
+
+def _digest_le_bytes(state):
+    """SHA-512 state -> the 64 digest bytes as (B,) int32 lanes, in
+    LITTLE-ENDIAN integer order (byte 0 = LSB of the 512-bit value the
+    mod-L reduction consumes). The stream itself is big-endian per
+    64-bit word, which is exactly this ordering read front to back."""
+    out = []
+    for i in range(8):
+        for w in state[i]:
+            for k in range(4):
+                out.append(((w >> (24 - 8 * k)) & 0xFF)
+                           .astype(jnp.int32))
+    return out
+
+
+# -- digest mod L in 13-bit int32 limbs ------------------------------------
+
+_SC_L = ref.L
+_SC_C = _SC_L - (1 << 252)          # L = 2^252 + c
+_SC_C1 = _SC_C << 8                 # 2^260 === -c1 (mod L): limb-aligned
+
+
+def _limbs13_int(v: int, n: int):
+    return tuple((v >> (13 * i)) & 0x1FFF for i in range(n))
+
+
+_C_LIMBS = _limbs13_int(_SC_C, 10)      # c  < 2^125
+_C1_LIMBS = _limbs13_int(_SC_C1, 11)    # c1 < 2^133
+_L_LIMBS13 = _limbs13_int(_SC_L, 20)
+_L_U32 = tuple(int(w) for w in np.frombuffer(
+    _SC_L.to_bytes(32, "little"), "<u4"))
+
+
+def _fold_offset(n_conv: int):
+    """A multiple of L, represented with per-limb headroom 2^30 over
+    the first n_conv limbs, so `lo + offset - conv(hi, c1)` never goes
+    negative in any lane (conv lanes are < 11 * 2^26 < 2^30). Keeps
+    the whole fold chain in nonnegative int32 limbs."""
+    s = sum(1 << (13 * k) for k in range(n_conv))
+    r = (-(1 << 30) * s) % _SC_L
+    m = max(n_conv, 20)
+    v = [0] * m
+    for k in range(n_conv):
+        v[k] += 1 << 30
+    for k, rl in enumerate(_limbs13_int(r, 20)):
+        v[k] += rl
+    return tuple(v)
+
+
+_FOLD_OFFS = (_fold_offset(30), _fold_offset(22), _fold_offset(14))
+
+
+def _carry13(y, extra: int):
+    """Sequential carry propagation to canonical 13-bit limbs (int32
+    arithmetic shift = floor semantics, so the same loop serves the
+    signed 252-bit fold). `extra` top limbs absorb the final carry."""
+    out = []
+    carry = None
+    for t in y:
+        if carry is not None:
+            t = t + carry
+        out.append(t & 0x1FFF)
+        carry = t >> 13
+    for _ in range(extra):
+        out.append(carry & 0x1FFF)
+        carry = carry >> 13
+    return out
+
+
+def _fold_limbs(limbs, off):
+    """One fold at the 2^260 limb boundary: x = lo + 2^260*hi ===
+    lo - c1*hi (mod L), plus the nonneg offset. Canonical 13-bit limbs
+    in, canonical out (len(off) + 2 limbs)."""
+    lo, hi = limbs[:20], limbs[20:]
+    n_conv = len(hi) + len(_C1_LIMBS) - 1
+    zero = jnp.zeros_like(limbs[0])
+    y = []
+    for k in range(len(off)):
+        t = (lo[k] if k < 20 else zero) + off[k]
+        if k < n_conv:
+            s = zero
+            for i in range(len(hi)):
+                j = k - i
+                if 0 <= j < len(_C1_LIMBS):
+                    s = s + hi[i] * _C1_LIMBS[j]
+            t = t - s
+        y.append(t)
+    return _carry13(y, extra=2)
+
+
+def _mod_l_nibbles(dig_bytes):
+    """64 little-endian digest byte lanes -> the 64 base-16 digits of
+    (digest mod L) — hdig, exactly `nibbles(digest % L as 32 LE
+    bytes)` from the host pack. Three limb-aligned folds take 512 ->
+    ~260 bits, a 252-bit fold lands in [0, 2L), and one conditional
+    subtract canonicalizes."""
+    zero = jnp.zeros_like(dig_bytes[0])
+    pad = list(dig_bytes) + [zero] * 3
+    limbs = []
+    for i in range(40):
+        j, r = divmod(13 * i, 8)
+        win = pad[j] | (pad[j + 1] << 8) | (pad[j + 2] << 16)
+        limbs.append((win >> r) & 0x1FFF)
+    for off in _FOLD_OFFS:
+        limbs = _fold_limbs(limbs, off)
+    # 252-bit fold: x = q*2^252 + r === r + (L - q*c) in [0, 2L)
+    q = (limbs[19] >> 5) | (limbs[20] << 8) | (limbs[21] << 21)
+    y = []
+    for k in range(20):
+        t = (limbs[k] if k < 19 else (limbs[19] & 0x1F)) + _L_LIMBS13[k]
+        if k < len(_C_LIMBS):
+            t = t - q * _C_LIMBS[k]
+        y.append(t)
+    res = _carry13(y, extra=0)
+    # conditional subtract: borrow-free z means res >= L, take z
+    z = []
+    carry = zero
+    for k in range(20):
+        t = res[k] - _L_LIMBS13[k] + carry
+        z.append(t & 0x1FFF)
+        carry = t >> 13
+    ge = carry == 0
+    res = [jnp.where(ge, z[k], res[k]) for k in range(20)]
+    nibs = []
+    for t_i in range(64):
+        i, r = divmod(4 * t_i, 13)
+        v = res[i] >> r
+        if r > 9 and i + 1 < 20:
+            v = v | (res[i + 1] << (13 - r))
+        nibs.append(v & 15)
+    return nibs
+
+
+# -- the stamping prologue --------------------------------------------------
+
+
+def _stamp_rows_core(sig, ts, flags, pre_mat, pre_len, suf_mat,
+                     suf_len, ts_tag, pub_raw, thr,
+                     msg_max: int, t_rows: int):
+    """(per-row deltas, device template, valset pubkeys) -> the packed
+    (V_THRESH + t_rows, B) rows — bit-identical to pack_rows_cached
+    over a host pack_batch of the expanded batch.
+
+    sig (B, 64) uint8 raw signatures; ts (B, 3) int32 [secs_lo,
+    secs_hi, nanos]; flags (B,) int32 with bit0=live, bit1=counted,
+    bits 2..9 = template row, bits 10.. = commit id. Dead lanes
+    (live=0, the pool's zero fill) produce all-zero columns exactly
+    like the legacy zero-filled padding rows. thr is the tiny
+    (n_commits, TALLY_LIMBS) threshold matrix, expanded into the
+    trailing rows on device (staging it pre-expanded would ship
+    t_rows*B words for n_commits*6 of content)."""
+    B = sig.shape[0]
+    pm = pre_mat.shape[1]
+    live = (flags & 1).astype(jnp.int32)
+    counted = (flags >> 1) & 1
+    tmpl = (flags >> 2) & 0xFF
+    cid = flags >> 10
+    sig32 = sig.astype(jnp.int32)
+
+    # timestamp varints + proto3 zero-skip lengths (patch_rows math)
+    sb, sl = _dev_uvarint64(ts[:, 0], ts[:, 1])
+    nb, nl = _dev_uvarint64(ts[:, 2], ts[:, 2] >> 31)
+    s_nz = ((ts[:, 0] | ts[:, 1]) != 0).astype(jnp.int32)
+    n_nz = (ts[:, 2] != 0).astype(jnp.int32)
+    sfl = jnp.where(s_nz != 0, sl + 1, 0)
+    nfl = jnp.where(n_nz != 0, nl + 1, 0)
+    ts_len = sfl + nfl
+    p_row = pre_len[tmpl]
+    s_row = suf_len[tmpl]
+    body_len = p_row + 2 + ts_len + s_row
+    ob, ol = _dev_uvarint32(body_len)
+    total = ol + body_len
+
+    # one gather assembles every row from a per-row source vector via
+    # piecewise-iota boundaries (the segment layout of patch_rows)
+    src = jnp.concatenate([
+        ob,                                   # +0        outer varint
+        pre_mat[tmpl].astype(jnp.int32),      # +5
+        ts_tag[tmpl][:, None],                # +5+pm
+        ts_len[:, None],                      # +6+pm
+        jnp.full((B, 1), 0x08, jnp.int32),    # +7+pm     seconds tag
+        sb,                                   # +8+pm
+        jnp.full((B, 1), 0x10, jnp.int32),    # +18+pm    nanos tag
+        nb,                                   # +19+pm
+        suf_mat[tmpl].astype(jnp.int32),      # +20+pm
+        jnp.zeros((B, 1), jnp.int32),         # +20+pm+sm dead lane
+    ], axis=1)
+    o_pre, o_tag = 5, 5 + pm
+    o_tsl, o_t08, o_sb = o_tag + 1, o_tag + 2, o_tag + 3
+    o_t10, o_nb = o_sb + 10, o_sb + 11
+    o_suf = o_nb + 10
+    o_z = o_suf + suf_mat.shape[1]
+    col = lambda x: x[:, None]  # noqa: E731
+    b0 = col(ol)
+    b1 = b0 + col(p_row)
+    b2 = b1 + 1
+    b3 = b2 + 1
+    b4 = b3 + col(s_nz)
+    b5 = b4 + col(sl * s_nz)
+    b6 = b5 + col(n_nz)
+    b7 = b6 + col(nl * n_nz)
+    b8 = b7 + col(s_row)
+    p = jnp.arange(msg_max, dtype=jnp.int32)[None, :]
+    idx = jnp.where(p < b0, p,
+          jnp.where(p < b1, o_pre + (p - b0),
+          jnp.where(p < b2, o_tag,
+          jnp.where(p < b3, o_tsl,
+          jnp.where(p < b4, o_t08,
+          jnp.where(p < b5, o_sb + (p - b4),
+          jnp.where(p < b6, o_t10,
+          jnp.where(p < b7, o_nb + (p - b6),
+          jnp.where(p < b8, o_suf + (p - b7), o_z)))))))))
+    msg = jnp.take_along_axis(src, idx, axis=1)
+
+    # full padded SHA-512 input: R || A || msg || 0x80 || 0* || bitlen
+    # (the length field is 128-bit — 17 pad bytes minimum, not 9; our
+    # bit counts fit 24 bits so only the low 4 length bytes are ever
+    # nonzero)
+    nblk = (64 + msg_max + 17 + 127) // 128
+    width = nblk * 128
+    vidx = jnp.arange(B, dtype=jnp.int32) % pub_raw.shape[0]
+    a_row = pub_raw[vidx].astype(jnp.int32)
+    data = jnp.concatenate(
+        [sig32[:, :32], a_row, msg,
+         jnp.zeros((B, width - 64 - msg_max), jnp.int32)], axis=1)
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    tm = col(64 + total)
+    data = data | jnp.where(pos == tm, 0x80, 0)
+    nblk_row = (tm + 17 + 127) // 128
+    bits = tm * 8
+    rel = pos - (nblk_row * 128 - 8)
+    sh = jnp.clip((7 - rel) * 8, 0, 24)
+    data = data | jnp.where((rel >= 4) & (rel < 8),
+                            (bits >> sh) & 0xFF, 0)
+    st = _sha512_blocks(data, nblk_row[:, 0], nblk)
+    nibs = _mod_l_nibbles(_digest_le_bytes(st))
+
+    # packed-row assembly (pack_rows_cached's exact layout)
+    h4_rows = [sum(nibs[8 * k + j] << (4 * k) for k in range(8)) * live
+               for j in range(8)]
+    s8_rows = [sum(sig32[:, 32 + 8 * k + j] << (8 * k)
+                   for k in range(4)) * live for j in range(8)]
+    zero = jnp.zeros_like(live)
+    rb = [sig32[:, k] for k in range(32)] + [zero] * 3
+    rb[31] = rb[31] & 0x7F
+    rl = []
+    for i in range(NLIMBS):
+        j, r = divmod(13 * i, 8)
+        win = rb[j] | (rb[j + 1] << 8) | (rb[j + 2] << 16)
+        rl.append((win >> r) & 0x1FFF)
+    ry_rows = [(rl[i] | (rl[i + 10] << 13)) * live for i in range(10)]
+    rsign = (sig32[:, 31] >> 7) * live
+    lt = jnp.zeros((B,), jnp.bool_)
+    dec = jnp.zeros((B,), jnp.bool_)
+    for k in range(7, -1, -1):
+        wk = (sig[:, 32 + 4 * k].astype(jnp.uint32)
+              | (sig[:, 33 + 4 * k].astype(jnp.uint32) << 8)
+              | (sig[:, 34 + 4 * k].astype(jnp.uint32) << 16)
+              | (sig[:, 35 + 4 * k].astype(jnp.uint32) << 24))
+        mw = jnp.uint32(_L_U32[k])
+        lt = lt | (~dec & (wk < mw))
+        dec = dec | (wk != mw)
+    precheck = lt.astype(jnp.int32) * live
+    f_row = (rsign | (precheck << 1) | ((counted * live) << 2)
+             | ((cid * live) << 3))
+    flat = thr.reshape(-1).astype(jnp.int32)
+    flat = jnp.pad(flat, (0, t_rows * B - flat.shape[0]))
+    head = jnp.stack(ry_rows + s8_rows + h4_rows + [f_row], axis=0)
+    return jnp.concatenate([head, flat.reshape(t_rows, B)], axis=0)
+
+
+_stamp_rows_jit = jax.jit(_stamp_rows_core,
+                          static_argnames=("msg_max", "t_rows"))
+
+
+def stamp_rows_cached(sig, ts, flags, ent: TemplateEntry,
+                      table: ValsetTable, n_commits: int = 1,
+                      thresh=None):
+    """Device-stamped packed rows for a delta flush — what
+    pack_rows_cached would build from the expanded batch, assembled on
+    device (differential-tested bit-identical). Requires a
+    stamping-aware table (pub_raw present)."""
+    if table.pub_raw is None:
+        raise ValueError(
+            "delta flush needs a table built with pub_raw")
+    B = int(sig.shape[0])
+    t_rows = packed_rows_shape(B, n_commits)[0] - V_THRESH
+    if thresh is None:
+        thresh = np.zeros((1, ek.TALLY_LIMBS), np.int32)
+    return _stamp_rows_jit(
+        jnp.asarray(sig), jnp.asarray(ts), jnp.asarray(flags),
+        ent.pre_mat, ent.pre_len, ent.suf_mat, ent.suf_len,
+        ent.ts_tag, table.pub_raw,
+        jnp.asarray(np.asarray(thresh, np.int32)),
+        msg_max=ent.msg_max, t_rows=t_rows)
+
+
+def verify_tally_delta_cached(sig, ts, flags, ent: TemplateEntry,
+                              table: ValsetTable, n_commits: int,
+                              thresh=None):
+    """Fused verify+tally for a delta-staged flush: the stamping
+    prologue expands (template, deltas) into the packed rows ON
+    DEVICE, then the cached verify kernel consumes them — the rows
+    never exist host-side. Two dispatches by design: keeping
+    _verify_tally_cached a separately-jitted module attribute
+    preserves the kernel-stub seam the shardplane prog patches, and
+    the rows stay device-resident between the two."""
+    rows = stamp_rows_cached(sig, ts, flags, ent, table, n_commits,
+                             thresh)
+    return _verify_tally_cached(rows, table.tab, table.ok,
+                                table.power5, base60_dev(), n_commits)
 
 
 def verify_tally_rows_cached(rows, table: ValsetTable, n_commits: int):

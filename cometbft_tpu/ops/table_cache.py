@@ -57,8 +57,10 @@ LOCK = threading.RLock()
 STATS = {"hits": 0, "misses": 0, "key_memo_hits": 0,
          "valset_hits": 0, "valset_misses": 0,
          "shard_hits": 0, "shard_misses": 0,
+         "template_hits": 0, "template_misses": 0,
          "evictions_tables": 0, "evictions_shard": 0,
          "evictions_valset_memo": 0, "evictions_key_memo": 0,
+         "evictions_templates": 0,
          "warmed_hits": 0, "incremental_patches": 0}
 
 
@@ -176,15 +178,25 @@ KEY_MEMO = BoundedLRU("key_memo", 16)
 # ValidatorSet objects (10k Validator dataclasses per epoch) — the
 # biggest host-side churn leak surface, bounded here.
 VALSET_MEMO = BoundedLRU("valset_memo", 8)
+# stamp-site content key -> device-resident encoded template (ISSUE 19
+# device-side sign-bytes stamping). One entry per template family the
+# delta path flushes against (~a few hundred bytes each, next to the
+# valset window tables it rides with). Same live-entry safety as the
+# tables: capacity >= 2, every hit refreshes recency, and a plan that
+# holds an entry keeps its device buffers alive even across an evict —
+# the live template is never freed mid-flush.
+TEMPLATES = BoundedLRU("templates", 8, size_fn=default_size)
 
 _CACHES = {"tables": TABLES, "shard_tables": SHARDS,
-           "key_memo": KEY_MEMO, "valset_memo": VALSET_MEMO}
+           "key_memo": KEY_MEMO, "valset_memo": VALSET_MEMO,
+           "templates": TEMPLATES}
 
 
 def set_capacities(tables: Optional[int] = None,
                    shard_tables: Optional[int] = None,
                    key_memo: Optional[int] = None,
-                   valset_memo: Optional[int] = None) -> None:
+                   valset_memo: Optional[int] = None,
+                   templates: Optional[int] = None) -> None:
     """Configure cache capacities ([crypto] table_cache_* knobs).
     Each is clamped to >= 2 (capacity 1 would let a next-epoch warm
     insert evict the LIVE epoch's table mid-flush)."""
@@ -197,6 +209,8 @@ def set_capacities(tables: Optional[int] = None,
             KEY_MEMO.set_capacity(key_memo)
         if valset_memo is not None:
             VALSET_MEMO.set_capacity(valset_memo)
+        if templates is not None:
+            TEMPLATES.set_capacity(templates)
 
 
 def capacities() -> dict:

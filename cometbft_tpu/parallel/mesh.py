@@ -388,3 +388,90 @@ def sharded_fused_verify(mesh: Mesh, n_commits: int):
     )
     fn = jax.jit(sharded)
     return _cache_put(key, fn)
+
+
+def sharded_stamped_verify(mesh: Mesh, n_commits: int, msg_max: int):
+    """sharded_fused_verify's DELTA twin: each device stamps its own
+    rows slice from the per-row deltas before the cached kernel runs.
+
+    The staged deltas shard exactly like the rows they expand into —
+    sig/ts shard on the row axis, flags on its only axis — because
+    fused.shard_positions already laid row `d*B_loc + s*M_s + v_loc`
+    out as device d's stride-s slot for local validator v_loc: the
+    stamping prologue's `row mod pub_raw_len -> validator` gather then
+    resolves against the device's OWN (M_s, 32) pub_raw shard with no
+    index plumbing, and the expanded slice is bit-identical to the
+    single-device oracle's slice (the shardplane prog's stamped
+    phase). Template matrices replicate (a few hundred bytes, one
+    family per flush); thresholds ride the replicated `threshold` arg
+    as ever — the in-rows threshold rows are zeros here (t_rows=1),
+    matching the sharded fused path's discard of the in-kernel quorum.
+
+    Memoized per (mesh, n_commits, msg_max): msg_max is a static of
+    the stamp trace; the template matrices' bucketed shapes retrace
+    under jit's own cache like any other arg shape."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    key = ("stamped", _mesh_key(mesh), int(n_commits), int(msg_max))
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    axis = mesh.axis_names[0]
+
+    def step(sig, ts, flags, pre_mat, pre_len, suf_mat, suf_len,
+             ts_tag, pub_raw, tab, ok, power5, base, threshold):
+        thr0 = jax.numpy.zeros((1, ek.TALLY_LIMBS), jax.numpy.int32)
+        rows = ec._stamp_rows_core(
+            sig, ts, flags, pre_mat, pre_len, suf_mat, suf_len,
+            ts_tag, pub_raw, thr0, msg_max=msg_max, t_rows=1)
+        valid, local, _ = ec._verify_tally_cached.__wrapped__(
+            rows, tab, ok, power5, base, n_commits
+        )
+        total = _carry_tally(jax.lax.psum(local, axis))
+        quorum = ek.quorum_core(total, threshold)
+        return valid, total, quorum
+
+    sharded = _smap(
+        step,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis),
+                  P(), P(), P(), P(), P(),
+                  P(axis, None), P(axis, None), P(axis), P(axis, None),
+                  P(), P()),
+        out_specs=(P(axis), P(), P()),
+        unchecked=True,
+    )
+    fn = jax.jit(sharded)
+    return _cache_put(key, fn)
+
+
+def sharded_stamp_rows(mesh: Mesh, msg_max: int):
+    """Test/oracle step: ONLY the per-shard stamping prologue, rows
+    gathered back lane-sharded — so the shardplane prog can assert the
+    per-device stamped slices bit-match the single-device expansion
+    without running the verify kernel."""
+    from cometbft_tpu.ops import ed25519_cached as ec
+
+    key = ("stamp-rows", _mesh_key(mesh), int(msg_max))
+    cached = _cache_get(key)
+    if cached is not None:
+        return cached
+    axis = mesh.axis_names[0]
+
+    def step(sig, ts, flags, pre_mat, pre_len, suf_mat, suf_len,
+             ts_tag, pub_raw):
+        thr0 = jax.numpy.zeros((1, ek.TALLY_LIMBS), jax.numpy.int32)
+        return ec._stamp_rows_core(
+            sig, ts, flags, pre_mat, pre_len, suf_mat, suf_len,
+            ts_tag, pub_raw, thr0, msg_max=msg_max, t_rows=1)
+
+    sharded = _smap(
+        step,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis),
+                  P(), P(), P(), P(), P(), P(axis, None)),
+        out_specs=P(None, axis),
+        unchecked=True,
+    )
+    fn = jax.jit(sharded)
+    return _cache_put(key, fn)

@@ -152,6 +152,104 @@ class SignRows:
                 for i in range(n)]
 
 
+class StampSite:
+    """The per-template metadata a device stamping prologue needs to
+    expand (secs, nanos) deltas into complete sign-bytes rows: the
+    invariant prefix/suffix byte arrays, the timestamp field tag, the
+    varint width bounds, and the worst-case row length (ISSUE 19).
+
+    The layout contract (mirrored by the numpy reference in
+    ``patch_rows`` and the XLA port in ops/ed25519_cached):
+
+        row = uvarint(body_len) | pre | TS_TAG | ts_len
+              | [0x08 secs-varint]? | [0x10 nanos-varint]? | suf
+
+    with the two timestamp fields zero-skipped (proto3 scalar rules)
+    and body_len = P + 2 + ts_len + S. ``ol_max`` bounds the outer
+    length prefix; ``max_len`` bounds the whole row — the device pads
+    its row matrix to a bucket of it."""
+
+    __slots__ = ("pre", "suf", "ts_tag", "ol_max", "max_len")
+
+    # timestamp body worst case: 0x08 + 10-byte secs + 0x10 + 10-byte
+    # nanos (64-bit two's-complement varints)
+    TS_LEN_MAX = 22
+
+    def __init__(self, pre: np.ndarray, suf: np.ndarray, ts_tag: int):
+        self.pre = pre
+        self.suf = suf
+        self.ts_tag = ts_tag
+        body_max = pre.size + 2 + self.TS_LEN_MAX + suf.size
+        self.ol_max = len(pe.uvarint(body_max))
+        self.max_len = self.ol_max + body_max
+
+    @property
+    def key(self) -> tuple:
+        """Content identity: device template caches key on this."""
+        return (self.pre.tobytes(), self.suf.tobytes(), self.ts_tag)
+
+
+class DeltaRows:
+    """The compact per-row delta form of a vote batch: one int64
+    secs/nanos pair per row against a shared VoteRowTemplate, not full
+    packed sign-bytes (ISSUE 19 — ~16 B/row of payload where a packed
+    row carries the whole message). ``ts_words()`` is the exact int32
+    staging layout the device prologue consumes (no jax x64: a 64-bit
+    seconds value ships as a lo/hi pair); ``expand()`` reconstructs the
+    rows from those words through the numpy reference — the
+    differential oracle proving the delta representation is lossless."""
+
+    __slots__ = ("template", "secs", "nanos")
+
+    def __init__(self, template: "VoteRowTemplate", secs: np.ndarray,
+                 nanos: np.ndarray):
+        self.template = template
+        self.secs = secs
+        self.nanos = nanos
+
+    def __len__(self) -> int:
+        return int(self.secs.shape[0])
+
+    def stampable(self) -> bool:
+        """Device-stamp eligibility: nanos must fit an int32 word (the
+        staging layout sign-extends it on device; out-of-range nanos —
+        never produced by a real Timestamp — fall back to host pack)."""
+        if self.nanos.size == 0:
+            return True
+        lo, hi = int(self.nanos.min()), int(self.nanos.max())
+        return lo >= -(2 ** 31) and hi < 2 ** 31
+
+    def ts_words(self) -> np.ndarray:
+        """(n, 3) int32 — the staged delta words: [secs_lo, secs_hi,
+        nanos]. secs splits as unsigned lo word + arithmetic-shift hi
+        word; the device prologue reassembles the 64-bit value from
+        the pair and sign-extends nanos from its single word."""
+        out = np.empty((self.secs.shape[0], 3), np.int32)
+        u = self.secs.view(np.uint64) if self.secs.dtype == np.int64 \
+            else np.asarray(self.secs, np.int64).view(np.uint64)
+        out[:, 0] = (u & np.uint64(0xFFFFFFFF)).astype(
+            np.uint32).view(np.int32)
+        out[:, 1] = (self.secs >> np.int64(32)).astype(np.int32)
+        out[:, 2] = self.nanos.astype(np.int32)
+        return out
+
+    @property
+    def nbytes(self) -> int:
+        """Staged delta payload bytes (the ledger's delta_bytes unit)."""
+        return int(self.secs.shape[0]) * 3 * 4
+
+    def expand(self) -> SignRows:
+        """Numpy reference expansion FROM THE STAGED WORDS — not from
+        the original int64s — so byte-equality against patch_rows
+        proves the int32 delta staging round-trips losslessly (the
+        cfg19_smoke acceptance check, no jax required)."""
+        w = self.ts_words()
+        secs = (w[:, 0].view(np.uint32).astype(np.uint64)
+                | (w[:, 1].astype(np.int64).view(np.uint64)
+                   << np.uint64(32))).view(np.int64)
+        return self.template.patch_rows(secs, w[:, 2].astype(np.int64))
+
+
 class VoteRowTemplate:
     """Vectorized row builder for one (chain_id, type, height, round,
     block_id): the invariant prefix/suffix encode once, then
@@ -182,6 +280,25 @@ class VoteRowTemplate:
         body = (self._pre + pe.f_msg(5, pe.timestamp(ts.seconds, ts.nanos))
                 + self._suf)
         return pe.delimited(body)
+
+    def stamp_site(self) -> StampSite:
+        """The device stamping contract for this template (memoized —
+        one per template, shared by every flush that cites it)."""
+        site = getattr(self, "_site", None)
+        if site is None:
+            site = StampSite(self._pre_arr, self._suf_arr, self.TS_TAG)
+            self._site = site
+        return site
+
+    def delta_rows(self, secs: Sequence[int],
+                   nanos: Sequence[int]) -> DeltaRows:
+        """The compact delta form of patch_rows: per-row (secs, nanos)
+        against this template, with the stamp-site metadata riding the
+        template itself. The device prologue (ops/ed25519_cached) ports
+        the vectorized varint/zero-skip/length-prefix math of
+        patch_rows; DeltaRows.expand() is the numpy oracle for it."""
+        return DeltaRows(self, np.asarray(secs, np.int64),
+                         np.asarray(nanos, np.int64))
 
     def patch_rows(self, secs: Sequence[int],
                    nanos: Sequence[int]) -> SignRows:

@@ -232,15 +232,33 @@ class VoteSet:
         rows = [(val.pub_key, vote.sign_bytes(self.chain_id),
                  vote.signature)]
         vidx = [vote.validator_index]
+        # device-stamp metadata: the vote row differs from its commit
+        # siblings only in timestamp, so the plane can ship the
+        # (template, secs, nanos) delta and stamp sign-bytes on device;
+        # extension rows have no vote template and stay host-packed
+        from cometbft_tpu.types.vote import sign_bytes_template
+        tmpl = sign_bytes_template(
+            self.chain_id, vote.vote_type, vote.height, vote.round,
+            None if vote.block_id.is_nil() else vote.block_id)
+        stamp = [(tmpl, vote.timestamp.seconds, vote.timestamp.nanos)]
+        # best-effort template prefetch: the rest of this height's
+        # votes cite the same site, so the warmer can stage the device
+        # template off the hot path (no-op once cached — PR 11 marks)
+        from cometbft_tpu.verifyplane import warmer as vwarmer
+        w = vwarmer.global_warmer()
+        if w is not None:
+            w.request_template((tmpl.stamp_site(),))
         if need_ext:
             rows.append((val.pub_key,
                          vote.extension_sign_bytes(self.chain_id),
                          vote.extension_signature))
             vidx.append(vote.validator_index)
+            stamp.append(None)
         try:
             fut = plane.submit_many(rows, power=val.voting_power,
                                     group=group, counted=counted,
-                                    vidx=vidx, chain_id=self.chain_id)
+                                    vidx=vidx, chain_id=self.chain_id,
+                                    stamp=stamp)
             verdicts = fut.result()
         except PlaneError:
             # plane stopped/saturated mid-call: serial host fallback

@@ -55,6 +55,61 @@ MAX_FUSED_ROWS = 65536  # per-device rows budget (B_loc when sharded)
 # costs minutes per compile.
 ALLOW_CPU_FUSED = False
 
+# Device-side sign-bytes stamping (ISSUE 19): template-eligible flushes
+# ship (device-resident template, per-row deltas) and the stamping
+# prologue rebuilds the packed rows on device. Module-level toggle +
+# setter (the validation._TEMPLATE_PACK pattern) so the config plumbs
+# it and the differential tests force either path.
+DEVICE_STAMP = True
+
+
+def set_device_stamping(on: bool) -> None:
+    global DEVICE_STAMP
+    DEVICE_STAMP = bool(on)
+
+
+# jax-free replicas of the packed-row layout constants, for the staging
+# byte-budget arithmetic below (cfg19_smoke runs with no jax import;
+# tests cross-check these against ed25519_cached.V_THRESH /
+# ed25519_kernel.TALLY_LIMBS in a jax-enabled process)
+_V_THRESH_REPLICA = 27
+_TALLY_LIMBS_REPLICA = 6
+
+
+def delta_slot_specs(B: int) -> dict:
+    """name -> (shape, itemsize) of the staging slots a DEVICE-STAMPED
+    flush of B rows occupies: raw signatures, the (secs_lo, secs_hi,
+    nanos) timestamp words, and the packed live/counted/template/commit
+    flags. Pure arithmetic — the cfg19_smoke byte budget."""
+    return {"fused.dsig": ((B, 64), 1),
+            "fused.dts": ((B, 3), 4),
+            "fused.dflags": ((B,), 4)}
+
+
+def legacy_slot_specs(B: int, n_commits: int = 1) -> dict:
+    """name -> (shape, itemsize) of the staging slots a HOST-PACKED
+    flush of B rows occupies (the scatter buffers plus the packed rows
+    the device actually reads)."""
+    t_rows = max(1, -(-(n_commits * _TALLY_LIMBS_REPLICA) // B))
+    return {"fused.ry": ((B, 20), 4),
+            "fused.rsign": ((B,), 4),
+            "fused.sdig": ((B, 64), 4),
+            "fused.hdig": ((B, 64), 4),
+            "fused.precheck": ((B,), 1),
+            "fused.counted": ((B,), 1),
+            "fused.cid": ((B,), 4),
+            "fused.rows": ((_V_THRESH_REPLICA + t_rows, B), 4)}
+
+
+def specs_bytes(specs: dict) -> int:
+    total = 0
+    for shape, itemsize in specs.values():
+        n = itemsize
+        for d in shape:
+            n *= d
+        total += n
+    return total
+
 
 class _Plan:
     """A fully host-side staged fused flush: everything up to (but not
@@ -69,7 +124,13 @@ class _Plan:
     __slots__ = ("rows", "pos", "batch", "groups", "sub_gid",
                  "counted_pos", "n_commits", "pubs_v", "powers_v",
                  "pending", "mesh", "n_dev", "thresh", "devs",
-                 "drain_first", "warm", "util")
+                 "drain_first", "warm", "util",
+                 # device-stamped delta staging: `stamped` selects the
+                 # path, `delta` holds the (sig, ts, flags) staging
+                 # buffers, `sites` the StampSites in template-id
+                 # order, `delta_bytes` the staged delta footprint
+                 # (rows is None on this path)
+                 "stamped", "delta", "sites", "delta_bytes")
 
 
 def _eligible(batch):
@@ -95,6 +156,41 @@ def _eligible(batch):
     if pubs0 is None:
         return None
     return pubs0, powers0
+
+
+def _stamp_sites(stamp_meta, row_gid, max_sites: int):
+    """Template-id assignment + device-stamp eligibility for a flush.
+
+    Returns (StampSites in template-id order, per-row template ids) or
+    None when the flush must fall back to host packing: a row without
+    stamp metadata (non-vote rows — e.g. extension rows), timestamp
+    words outside the staged int32 layout, more than the
+    for-block/for-nil template pair among one commit's rows, or more
+    template families than the staged flags' 8-bit id field."""
+    ids: List[int] = []
+    sites: List[object] = []
+    idx_of: Dict[object, int] = {}
+    per_gid: Dict[int, set] = {}
+    for st, gid in zip(stamp_meta, row_gid):
+        if st is None:
+            return None
+        tpl, secs, nanos = st
+        if not (-2**31 <= nanos < 2**31 and -2**63 <= secs < 2**63):
+            return None
+        site = tpl.stamp_site()
+        key = site.key
+        tid = idx_of.get(key)
+        if tid is None:
+            if len(sites) >= max_sites:
+                return None
+            tid = idx_of[key] = len(sites)
+            sites.append(site)
+        gset = per_gid.setdefault(gid, set())
+        gset.add(key)
+        if len(gset) > 2:
+            return None  # mixed block_ids past the for-block/nil pair
+        ids.append(tid)
+    return tuple(sites), ids
 
 
 def shard_positions(vidx, strides, m_shard: int,
@@ -241,6 +337,8 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
     sigs: List[bytes] = []
     row_v: List[int] = []
     row_s: List[int] = []
+    row_gid: List[int] = []
+    stamp_meta: List[Optional[tuple]] = []  # (template, secs, nanos)
     counted_ridx: List[Optional[int]] = []  # per submission: row index
     occupied: List[set] = []
     groups: List[object] = []
@@ -254,6 +352,7 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
             groups.append(g)
         sub_gid.append(gid)
         cidx = None
+        stamps = getattr(sub, "stamp", None)
         for k, ((pub, msg, sig), v) in enumerate(zip(sub.rows, sub.vidx)):
             if not (0 <= v < nvals) or pub.data != pubs_v[v] \
                     or len(sig) != 64:
@@ -269,6 +368,9 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
             sigs.append(sig)
             row_v.append(v)
             row_s.append(s)
+            row_gid.append(gid)
+            stamp_meta.append(stamps[k] if stamps is not None
+                              and k < len(stamps) else None)
             if k == 0 and sub.counted:
                 if sub.power != powers_v[v]:
                     return None  # tally rides the table's power column
@@ -307,7 +409,6 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
     B = n_dev * n_strides * M
 
     n_commits = len(groups)
-    pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
     pos = shard_positions(row_v, row_s, M, n_strides)
     counted_pos = [None if ci is None else int(pos[ci])
                    for ci in counted_ridx]
@@ -320,42 +421,91 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
         from cometbft_tpu.crypto.batch import staging_pool
 
         pool = staging_pool()
-    ry = pool.get("fused.ry", (B, pbd.ry.shape[1]), pbd.ry.dtype)
-    ry[pos] = pbd.ry[:n]
-    rsign = pool.get("fused.rsign", (B,), np.int32)
-    rsign[pos] = np.asarray(pbd.rsign[:n], np.int32)
-    sdig = pool.get("fused.sdig", (B, pbd.sdig.shape[1]), pbd.sdig.dtype)
-    sdig[pos] = pbd.sdig[:n]
-    hdig = pool.get("fused.hdig", (B, pbd.hdig.shape[1]), pbd.hdig.dtype)
-    hdig[pos] = pbd.hdig[:n]
-    precheck = pool.get("fused.precheck", (B,), np.bool_)
-    precheck[pos] = np.asarray(pbd.precheck[:n], np.bool_)
-    counted = pool.get("fused.counted", (B,), np.bool_)
-    commit_ids = pool.get("fused.cid", (B,), np.int32)
-    cur = 0
-    for sub, gid, cpos in zip(batch, sub_gid, counted_pos):
-        for p in pos[cur:cur + len(sub.rows)]:
-            commit_ids[p] = gid
-        cur += len(sub.rows)
-        if cpos is not None:
-            counted[cpos] = True
     thresh = np.zeros((n_commits, ek.TALLY_LIMBS), np.int32)
     for gid, g in enumerate(groups):
         thresh[gid] = ek.threshold_limbs(max(g.threshold - 1, 0))[0]
 
-    pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
-    # sharded: thresholds ride as a separate REPLICATED kernel argument
-    # (the in-rows threshold rows would shard into per-device fragments)
-    # so the packed rows carry a zero threshold row; single-device keeps
-    # packing them into the rows as before
-    pack_thresh = None if mesh is not None else thresh
-    out = pool.get(
-        "fused.rows",
-        ec.packed_rows_shape(B, 1 if mesh is not None else n_commits),
-        np.int32)
     plan = _Plan()
-    plan.rows = ec.pack_rows_cached(pb, counted, commit_ids, pack_thresh,
-                                    out=out)
+    stamp = (_stamp_sites(stamp_meta, row_gid, ec.MAX_TEMPLATE_SITES)
+             if DEVICE_STAMP else None)
+    if stamp is not None:
+        # device-stamped delta staging: ship 80 B/row — raw signature,
+        # (secs_lo, secs_hi, nanos) words, packed flags — and let the
+        # device prologue rebuild the packed rows next to the resident
+        # template. Slot layout mirrors delta_slot_specs; the pool's
+        # zero fill makes unoccupied lanes live=0, which the prologue
+        # expands to the same all-zero columns host packing pads with.
+        sites, site_ids = stamp
+        sec_a = np.array([st[1] for st in stamp_meta], np.int64)
+        nan_a = np.array([st[2] for st in stamp_meta], np.int64)
+        ts_rows = np.empty((n, 3), np.int32)
+        # the DeltaRows.ts_words split: unsigned lo word (int32 view) +
+        # arithmetic-shift hi word; nanos ride their own word
+        ts_rows[:, 0] = (sec_a & 0xFFFFFFFF).astype(np.uint32) \
+            .view(np.int32)
+        ts_rows[:, 1] = (sec_a >> 32).astype(np.int32)
+        ts_rows[:, 2] = nan_a.astype(np.int32)
+        fl_rows = np.ones((n,), np.int32)
+        fl_rows |= np.asarray(site_ids, np.int32) << 2
+        fl_rows |= np.asarray(row_gid, np.int32) << 10
+        for ci in counted_ridx:
+            if ci is not None:
+                fl_rows[ci] |= 2
+        dsig = pool.get("fused.dsig", (B, 64), np.uint8)
+        dsig[pos] = np.frombuffer(b"".join(sigs), np.uint8) \
+            .reshape(n, 64)
+        dts = pool.get("fused.dts", (B, 3), np.int32)
+        dts[pos] = ts_rows
+        dfl = pool.get("fused.dflags", (B,), np.int32)
+        dfl[pos] = fl_rows
+        plan.rows = None
+        plan.stamped = True
+        plan.delta = (dsig, dts, dfl)
+        plan.sites = sites
+        plan.delta_bytes = int(dsig.nbytes + dts.nbytes + dfl.nbytes)
+    else:
+        # legacy full-row host pack — bit-live as the differential
+        # oracle and the fallback for non-template-eligible flushes
+        pbd = ek.pack_batch(pubs, msgs, sigs, pad_to=n)
+        ry = pool.get("fused.ry", (B, pbd.ry.shape[1]), pbd.ry.dtype)
+        ry[pos] = pbd.ry[:n]
+        rsign = pool.get("fused.rsign", (B,), np.int32)
+        rsign[pos] = np.asarray(pbd.rsign[:n], np.int32)
+        sdig = pool.get("fused.sdig", (B, pbd.sdig.shape[1]),
+                        pbd.sdig.dtype)
+        sdig[pos] = pbd.sdig[:n]
+        hdig = pool.get("fused.hdig", (B, pbd.hdig.shape[1]),
+                        pbd.hdig.dtype)
+        hdig[pos] = pbd.hdig[:n]
+        precheck = pool.get("fused.precheck", (B,), np.bool_)
+        precheck[pos] = np.asarray(pbd.precheck[:n], np.bool_)
+        counted = pool.get("fused.counted", (B,), np.bool_)
+        commit_ids = pool.get("fused.cid", (B,), np.int32)
+        cur = 0
+        for sub, gid, cpos in zip(batch, sub_gid, counted_pos):
+            for p in pos[cur:cur + len(sub.rows)]:
+                commit_ids[p] = gid
+            cur += len(sub.rows)
+            if cpos is not None:
+                counted[cpos] = True
+
+        pb = _PB(None, None, ry, rsign, sdig, hdig, precheck)
+        # sharded: thresholds ride as a separate REPLICATED kernel
+        # argument (the in-rows threshold rows would shard into
+        # per-device fragments) so the packed rows carry a zero
+        # threshold row; single-device keeps packing them into the
+        # rows as before
+        pack_thresh = None if mesh is not None else thresh
+        out = pool.get(
+            "fused.rows",
+            ec.packed_rows_shape(B, 1 if mesh is not None else n_commits),
+            np.int32)
+        plan.rows = ec.pack_rows_cached(pb, counted, commit_ids,
+                                        pack_thresh, out=out)
+        plan.stamped = False
+        plan.delta = None
+        plan.sites = None
+        plan.delta_bytes = 0
     plan.pos = pos
     plan.batch = batch
     plan.groups = groups
@@ -401,8 +551,11 @@ def plan_ready(plan: _Plan) -> bool:
 
 
 def plan_h2d_bytes(plan: _Plan) -> int:
-    """Bytes this flush stages to the device (the packed rows; the
-    valset table is device-resident and uploads once per valset)."""
+    """Bytes this flush stages to the device (the packed rows, or the
+    per-row delta buffers when device-stamped; the valset table and
+    template are device-resident and upload once per valset/family)."""
+    if plan.stamped:
+        return int(plan.delta_bytes)
     return int(plan.rows.nbytes)
 
 
@@ -429,9 +582,16 @@ def dispatch_fused(plan: _Plan) -> None:
         # hashing) and a steady-state flush never re-uploads the valset
         table, plan.warm = ec.table_for_pubs_info(plan.pubs_v,
                                                   plan.powers_v)
-        plan.pending = ec.verify_tally_rows_cached(
-            plan.rows, table, plan.n_commits
-        )
+        if plan.stamped:
+            ent = ec.template_entry(plan.sites)
+            dsig, dts, dfl = plan.delta
+            plan.pending = ec.verify_tally_delta_cached(
+                dsig, dts, dfl, ent, table, plan.n_commits, plan.thresh
+            )
+        else:
+            plan.pending = ec.verify_tally_rows_cached(
+                plan.rows, table, plan.n_commits
+            )
         return
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -440,12 +600,36 @@ def dispatch_fused(plan: _Plan) -> None:
 
     table, plan.warm = ec.sharded_table_for_pubs_info(
         plan.pubs_v, plan.powers_v, plan.mesh)
-    step = pm.sharded_fused_verify(plan.mesh, plan.n_commits)
     axis = plan.mesh.axis_names[0]
-    rows_d = jax.device_put(
-        plan.rows, NamedSharding(plan.mesh, P(None, axis)))
     thresh_d = jax.device_put(
         plan.thresh, NamedSharding(plan.mesh, P(None, None)))
+    if plan.stamped:
+        # per-shard stamping: each device expands ITS rows slice from
+        # its delta slice + the replicated template + its own pub_raw
+        # shard — shard_positions already placed every row on the
+        # device owning its validator, so the stamped slices bit-match
+        # the single-device oracle's slices
+        ent = ec.template_entry(plan.sites)
+        step = pm.sharded_stamped_verify(plan.mesh, plan.n_commits,
+                                         ent.msg_max)
+        dsig, dts, dfl = plan.delta
+        row_sh = NamedSharding(plan.mesh, P(axis, None))
+        lane_sh = NamedSharding(plan.mesh, P(axis))
+        repl = NamedSharding(plan.mesh, P())
+        plan.pending = step(
+            jax.device_put(dsig, row_sh), jax.device_put(dts, row_sh),
+            jax.device_put(dfl, lane_sh),
+            jax.device_put(ent.pre_mat, repl),
+            jax.device_put(ent.pre_len, repl),
+            jax.device_put(ent.suf_mat, repl),
+            jax.device_put(ent.suf_len, repl),
+            jax.device_put(ent.ts_tag, repl),
+            table.pub_raw, table.tab, table.ok, table.power5,
+            ec.base60_repl(plan.mesh), thresh_d)
+        return
+    step = pm.sharded_fused_verify(plan.mesh, plan.n_commits)
+    rows_d = jax.device_put(
+        plan.rows, NamedSharding(plan.mesh, P(None, axis)))
     plan.pending = step(rows_d, table.tab, table.ok, table.power5,
                         ec.base60_repl(plan.mesh), thresh_d)
 

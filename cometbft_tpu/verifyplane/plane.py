@@ -156,20 +156,29 @@ PATH_FUSED_FALLBACK = "fused_host_fallback"  # in-flight device fault
 PATH_STOP_DRAIN = "stop_drain"      # settled by stop()'s drain budget
 PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 
+# row-assembly attribution for the fused paths (the ledger's `stamp`
+# column): device = the stamping prologue expanded per-row deltas next
+# to a resident template (ISSUE 19); host = full rows packed host-side
+# (the legacy path, still bit-live as the differential oracle and the
+# fallback for non-template-eligible flushes). Non-fused paths record
+# STAMP_HOST — their rows are host-assembled by definition.
+STAMP_DEVICE = "device"
+STAMP_HOST = "host"
+
 # Record-field indices. A flush's record is ONE list allocated at stage
 # time in FIELDS order (plus two trailing internal ns stamps the readers
 # never see); the dispatcher mutates it in place as stages land and the
 # very same list becomes the ring slot — "no allocation per flush beyond
 # the ring slot" is literal, not approximate.
 (_L_SEQ, _L_TS, _L_ROWS, _L_SUBS, _L_QUEUED, _L_PACK, _L_FLIGHT,
- _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_BRK, _L_SMISS,
+ _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_STAMP, _L_BRK, _L_SMISS,
  _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
- _L_NHOST, _L_DEV0, _L_WARM, _L_COMP, _L_H2D, _L_DEV,
- _L_UTIL, _L_TEN) = range(27)
+ _L_NHOST, _L_DEV0, _L_WARM, _L_COMP, _L_H2D, _L_DBYTES, _L_DEV,
+ _L_UTIL, _L_TEN) = range(29)
 # internal slots past the FIELDS window: ns stamps + the clock
 # generation they were taken under + the first-ready probe stamp
 # (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 27, 28, 29, 30
+_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 29, 30, 31, 32
 
 
 def _tenant_rows(col) -> dict:
@@ -255,7 +264,14 @@ class FlushLedger:
     / padded device slots staged (the rows-x-cost utilization of the
     pass; 0 on non-fused paths). comp_ms and h2d_ms decompose part
     of pack_ms (dispatch runs inside the pack span); dev_ms overlaps
-    flight+collect. ``tenants`` is the multi-tenant row attribution:
+    flight+collect. ``stamp`` attributes the flush's row assembly:
+    STAMP_DEVICE when the fused path shipped per-row deltas and the
+    device stamping prologue rebuilt the rows, STAMP_HOST when full
+    rows were packed host-side (legacy fused fallback and every
+    non-fused path). ``delta_bytes`` is the staged delta footprint of
+    a device-stamped flush (0 on host-packed flushes) — read next to
+    h2d_ms to see the shipped-bytes shrink the stamp bought.
+    ``tenants`` is the multi-tenant row attribution:
     sorted ((chain_id, rows), ...) pairs summing to the flush total —
     the ledger evidence that ONE flush coalesced rows from MANY
     chains (verifyplane/tenants.py; empty on shed-only cycles).
@@ -265,10 +281,10 @@ class FlushLedger:
 
     FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
               "flight_ms", "collect_ms", "settle_ms", "airborne",
-              "path", "breaker", "staging_miss", "depth",
+              "path", "stamp", "breaker", "staging_miss", "depth",
               "c_rows", "g_rows", "b_rows", "shed", "n_dev",
               "n_host", "dev0", "warm", "comp_ms", "h2d_ms",
-              "dev_ms", "util", "tenants")
+              "delta_bytes", "dev_ms", "util", "tenants")
 
     __slots__ = ("_ring",)
 
@@ -384,6 +400,16 @@ class FlushLedger:
             # round-5 class), and the h2d/on-device/utilization
             # figures over the fused flushes that measured them
             "device": _device_block(cols),
+            # row-assembly attribution: device-stamped vs host-packed
+            # flushes over the window, plus the staged delta bytes the
+            # stamped flushes shipped instead of full rows
+            "stamp": {
+                "device": sum(1 for s in cols["stamp"]
+                              if s == STAMP_DEVICE),
+                "host": sum(1 for s in cols["stamp"]
+                            if s == STAMP_HOST),
+                "delta_bytes": int(sum(cols["delta_bytes"])),
+            },
             # valset-table attribution over the fused paths: cold = a
             # flush that paid the table build/patch inline (the
             # post-rotation stall /dump_flushes localizes; the warmer
@@ -536,10 +562,10 @@ class QuorumGroup:
 class _Submission:
     __slots__ = ("rows", "future", "group", "power", "counted",
                  "vidx", "t_submit", "t_submit_led", "clock_gen", "tid",
-                 "lane", "tenant")
+                 "lane", "tenant", "stamp")
 
     def __init__(self, rows, group, power, counted, vidx=None,
-                 lane=LANE_CONSENSUS, tenant=None):
+                 lane=LANE_CONSENSUS, tenant=None, stamp=None):
         self.rows = rows                      # [(PubKey, msg, sig), ...]
         self.future = VerifyFuture()
         self.group = group
@@ -547,6 +573,12 @@ class _Submission:
         self.counted = bool(counted)
         self.vidx = tuple(vidx) if vidx is not None else None
         self.lane = lane
+        # device-stamp metadata: per-row (VoteRowTemplate, secs, nanos)
+        # tuples aligned with rows (None entries — e.g. extension rows
+        # — make the flush fall back to host packing). Attached by the
+        # vote-set submitter when the msg was built from the template,
+        # so metadata and bytes agree by construction.
+        self.stamp = stamp
         # tenancy key: which chain this work belongs to (DEFAULT_TENANT
         # when the caller predates the multi-tenant plane) — drives the
         # ledger's per-tenant attribution, the fair-share drain, and
@@ -846,9 +878,10 @@ class VerifyPlane:
                 len(settle), 0.0, 0.0, 0.0,
                 round((t1 - t0) / 1e6, 3),
                 round((tracing.monotonic_ns() - t1) / 1e6, 3),
-                0, PATH_STOP_DRAIN, self._breaker.state, 0, 0,
+                0, PATH_STOP_DRAIN, STAMP_HOST, self._breaker.state,
+                0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
-                1, 0, 0, 0.0, 0.0, 0.0, 0.0, _tenant_split(settle),
+                1, 0, 0, 0.0, 0.0, 0, 0.0, 0.0, _tenant_split(settle),
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -884,7 +917,8 @@ class VerifyPlane:
                     vidx: Optional[Sequence[int]] = None,
                     block: bool = True,
                     lane: str = LANE_CONSENSUS,
-                    chain_id: Optional[str] = None) -> VerifyFuture:
+                    chain_id: Optional[str] = None,
+                    stamp=None) -> VerifyFuture:
         """Submit several signatures as ONE unit (e.g. a vote and its
         extension): one future, per-row verdicts, and — when counted —
         the group tally credits `power` only if EVERY row verifies.
@@ -906,7 +940,12 @@ class VerifyPlane:
         past its pending-row quota on a sheddable lane is shed
         immediately with a TenantOverloaded verdict — a hard quota,
         not backpressure, so waiting is never offered. CONSENSUS is
-        structurally outside every tenant gate."""
+        structurally outside every tenant gate.
+
+        `stamp` (optional, aligned with rows) carries per-row
+        (VoteRowTemplate, secs, nanos) metadata so the fused path can
+        stage only deltas and stamp sign-bytes on device; None entries
+        (extensions, non-votes) force host packing for the flush."""
         if lane not in LANES:
             raise ValueError(f"unknown verify-plane lane {lane!r}")
         rows = list(rows)
@@ -915,7 +954,7 @@ class VerifyPlane:
         if not self._running or self.in_dispatcher():
             raise PlaneStopped("verify plane not accepting submissions")
         sub = _Submission(rows, group, power, counted, vidx, lane=lane,
-                          tenant=chain_id)
+                          tenant=chain_id, stamp=stamp)
         limit = self.lane_limit[lane]
         quota = (self.tenants.row_quota(sub.tenant)
                  if lane in SHEDDABLE_LANES else 0)
@@ -1170,8 +1209,9 @@ class VerifyPlane:
                     self.ledger.record([
                         next(self._flush_seq), round(t / 1e6, 3), 0, 0,
                         0.0, 0.0, 0.0, 0.0, 0.0, 0, PATH_SHED_ONLY,
+                        STAMP_HOST,
                         self._breaker.state, 0, depth, 0, 0, 0,
-                        len(shed), 0, 0, 0, 0, 0.0, 0.0, 0.0, 0.0, (),
+                        len(shed), 0, 0, 0, 0, 0.0, 0.0, 0, 0.0, 0.0, (),
                     ])
             if not batch:
                 # nothing to pack: land a flight (the first READY one,
@@ -1418,13 +1458,16 @@ class VerifyPlane:
             led[_L_SETTLE] = round((t_done - t_settle) / 1e6, 3)
         self.ledger.record(led)
 
-    def _observe_pack(self, seconds: float, h2d_bytes: int = 0) -> None:
+    def _observe_pack(self, seconds: float, h2d_bytes: int = 0,
+                      stamp: str = STAMP_HOST) -> None:
         self.pack_seconds += seconds
         self.h2d_bytes += h2d_bytes
         if self.metrics is not None:
             self.metrics.plane_pack_seconds.observe(seconds)
             if h2d_bytes:
-                self.metrics.plane_h2d_bytes.inc(h2d_bytes)
+                # split by staging path so a dashboard can watch the
+                # device-stamp rollout shrink the bus bill directly
+                self.metrics.plane_h2d_bytes.inc(h2d_bytes, path=stamp)
 
     def _stage(self, batch: List[_Submission], depth: int = 0,
                shed_n: int = 0, deck: List[_Flight] = ()):
@@ -1472,9 +1515,9 @@ class VerifyPlane:
         # gen, first-ready stamp); this list IS the eventual ring slot
         led = [next(self._flush_seq), round(t0 / 1e6, 3), rows,
                len(batch), queued_ms, 0.0, 0.0, 0.0, 0.0, 0,
-               PATH_HOST, self._breaker.state, 0, depth,
+               PATH_HOST, STAMP_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
-               0, 0, 0.0, 0.0, 0.0, 0.0, tuple(sorted(tens.items())),
+               0, 0, 0.0, 0.0, 0, 0.0, 0.0, tuple(sorted(tens.items())),
                t0, t0, gen, 0]
         for s in batch:
             # the join key consumers read AFTER the future resolves
@@ -1615,8 +1658,12 @@ class VerifyPlane:
                 deviceledger.attr_end(attr)
                 tracing.flight_begin("plane.flight", fid,
                                      cat="verifyplane", rows=len(rows))
-                self._observe_pack(time.perf_counter() - t0,
-                                   fz.plan_h2d_bytes(plan))
+                stamped = bool(getattr(plan, "stamped", False))
+                led[_L_STAMP] = STAMP_DEVICE if stamped else STAMP_HOST
+                led[_L_DBYTES] = getattr(plan, "delta_bytes", 0)
+                self._observe_pack(
+                    time.perf_counter() - t0, fz.plan_h2d_bytes(plan),
+                    stamp=led[_L_STAMP])
                 led[_L_COMP] = round(attr.ms, 3)
                 led[_L_UTIL] = plan.util
                 if tracing.clock_gen() == led[_L_GEN]:
@@ -1650,6 +1697,11 @@ class VerifyPlane:
                             "host fallback for this flush"
                         )
                         led[_L_PATH] = PATH_FUSED_FALLBACK
+                        # the host fallback re-verifies from raw rows:
+                        # whatever the device stamped never became a
+                        # verdict, so the stamp column degrades with
+                        # the path column
+                        led[_L_STAMP] = STAMP_HOST
                         # the verdicts below come from the HOST: a
                         # sharded flight that faulted must not keep
                         # claiming cross-chip fan-out (ledger n_dev

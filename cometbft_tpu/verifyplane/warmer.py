@@ -97,6 +97,11 @@ class TableWarmer:
         self.builds_incremental = 0
         self.superseded = 0
         self.last_build_ms = 0.0
+        # device stamping templates actually BUILT here (ISSUE 19):
+        # warm_template is a no-op on a cached entry, so this counts
+        # real prefetches only — same honesty rule as table marks
+        self.tmpl_warms = 0
+        self._tmpl_req: Optional[tuple] = None  # latest-wins sites
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -118,6 +123,7 @@ class TableWarmer:
                 return
             self._running = False
             self._req = None
+            self._tmpl_req = None
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -146,6 +152,23 @@ class TableWarmer:
             self._req = (pubs, powers, chain_id)
             self._cv.notify_all()
 
+    def request_template(self, sites) -> None:
+        """Warm the device stamping template for `sites` (a tuple of
+        canonical.StampSite — ISSUE 19). Latest-wins like table
+        requests, built on the warmer thread through
+        ed25519_cached.warm_template, which inserts into the bounded
+        template cache and warm-marks ONLY when the entry was absent
+        (the PR 11 honest-mark rule: a flush that already paid the
+        build inline must not credit the warmer). Best-effort by
+        design — a flush racing the same cold entry just builds it
+        itself."""
+        sites = tuple(sites)
+        with self._cv:
+            if not self._running or not sites:
+                return
+            self._tmpl_req = sites
+            self._cv.notify_all()
+
     def request_valset(self, vals,
                        chain_id: Optional[str] = None) -> None:
         """Warm for a types.validator.ValidatorSet. Column extraction
@@ -162,18 +185,44 @@ class TableWarmer:
     def _run(self) -> None:
         while True:
             with self._cv:
-                while self._running and self._req is None:
+                while self._running and self._req is None \
+                        and self._tmpl_req is None:
                     self._cv.wait(timeout=0.25)
                 if not self._running:
                     return
+                # tables first: a template entry is a few KB of encode
+                # work, the table is the multi-second program the
+                # rotation stall is made of
                 req, self._req = self._req, None
+                tmpl_req, self._tmpl_req = self._tmpl_req, None
                 self._building = True
             try:
-                self._build(*req)
+                if req is not None:
+                    self._build(*req)
+                if tmpl_req is not None:
+                    self._warm_template(tmpl_req)
             finally:
                 with self._cv:
                     self._building = False
                     self._cv.notify_all()
+
+    def _warm_template(self, sites: tuple) -> None:
+        """Template prefetch (never load-bearing: any failure is a
+        cold-path degrade, and a breaker-open device is left alone
+        exactly like table builds)."""
+        if self._breaker_open() or not self._device_ok():
+            self.builds_skipped += 1
+            return
+        try:
+            from cometbft_tpu.ops import ed25519_cached as ec
+
+            if ec.warm_template(sites):
+                self.tmpl_warms += 1
+        except Exception:  # noqa: BLE001 - prefetch fault: cold path
+            self.builds_failed += 1
+            _log.exception(
+                "stamping-template warm failed (%d sites); the next "
+                "delta flush builds it inline", len(sites))
 
     def _breaker_open(self) -> bool:
         brk = self._breaker
@@ -373,7 +422,8 @@ class TableWarmer:
         cfg13 bench use this to measure the warmed path honestly)."""
         deadline = time.monotonic() + timeout
         with self._cv:
-            while self._req is not None or self._building:
+            while self._req is not None or self._tmpl_req is not None \
+                    or self._building:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     return False
@@ -382,7 +432,8 @@ class TableWarmer:
 
     def stats(self) -> dict:
         with self._cv:
-            pending = self._req is not None or self._building
+            pending = self._req is not None \
+                or self._tmpl_req is not None or self._building
         return {
             "running": self._running,
             "pending": pending,
@@ -393,6 +444,7 @@ class TableWarmer:
             "builds_incremental": self.builds_incremental,
             "superseded": self.superseded,
             "last_build_ms": self.last_build_ms,
+            "tmpl_warms": self.tmpl_warms,
         }
 
 

@@ -64,7 +64,11 @@ def fake_build_table(pub_bytes, powers=None):
         jnp.zeros((padded // 128 * ec.ENT_BLOCK, 128), jnp.int16),
         jnp.asarray(ok), ec._power_dev(powers, padded), padded,
         ec._pubs_host(pub_bytes, padded),
-        ec._powers_host(powers, padded))
+        ec._powers_host(powers, padded),
+        # the REAL pub_raw: the device stamping prologue (ISSUE 19) is
+        # pure XLA — only the Pallas verify kernel is stubbed, so
+        # delta-staged flushes through this table stamp for real
+        ec._pub_raw(pub_bytes, padded))
 
 
 ec.build_table = fake_build_table
@@ -669,8 +673,70 @@ assert any("STEADY" in ln for ln in storm_snaps[0]["device_tail"]), \
     storm_snaps[0]["device_tail"]
 incidents.install(old_rec)
 
+# ---- phase I: stamped delta flush shards bit-identically ----------------
+# (ISSUE 19) The stamping prologue is REAL here — pure XLA, no Pallas
+# stub in its path: each device stamps its OWN rows slice from the
+# per-row deltas against its OWN (M_s, 32) pub_raw shard, and the
+# gathered matrix must be BIT-IDENTICAL to the single-device
+# expansion. B == M (one row per table slot) so the oracle's
+# `row mod M` validator gather and the shard-local `row mod M_s`
+# gather address the same keys — the layout fused.shard_positions
+# ships.
+
+from cometbft_tpu.ops import ed25519_kernel as ek  # noqa: E402
+from cometbft_tpu.types import canonical  # noqa: E402
+from cometbft_tpu.types.block_id import (  # noqa: E402
+    BlockID,
+    PartSetHeader,
+)
+from cometbft_tpu.types.timestamp import Timestamp  # noqa: E402
+
+M_I = 1024  # 4 shards x 256 stride
+FUZZ_S = [0, 1, 127, 128, 16383, 16384, 1_700_000_000, 2 ** 31 - 1,
+          2 ** 31, 2 ** 40, 2 ** 62, -1, -2 ** 33]
+privs_i = [PrivKey.generate((7000 + i).to_bytes(4, "big") + b"\x33" * 28)
+           for i in range(M_I // 16)]  # a live row every 16 slots
+pubs_i = [b""] * M_I
+for k, p in enumerate(privs_i):
+    pubs_i[k * 16] = p.pub_key().data
+bid_i = BlockID(b"\x19" * 32, PartSetHeader(4, b"\x91" * 32))
+tmpl_i = canonical.VoteRowTemplate(
+    "shard-chain", canonical.PRECOMMIT_TYPE, 5150, 0, bid_i)
+ent_i = ec.template_entry([tmpl_i.stamp_site()])
+sig_i = np.zeros((M_I, 64), np.uint8)
+dts_i = np.zeros((M_I, 3), np.int32)
+dfl_i = np.zeros((M_I,), np.int32)
+for k, p in enumerate(privs_i):
+    row = k * 16
+    s = FUZZ_S[k % len(FUZZ_S)]
+    nn = (k * 131) % 1_000_000_000
+    msg = canonical.canonical_vote_bytes(
+        "shard-chain", canonical.PRECOMMIT_TYPE, 5150, 0, bid_i,
+        Timestamp(s, nn))
+    sig_i[row] = np.frombuffer(p.sign(msg), np.uint8)
+    dts_i[row, 0] = np.uint32(s & 0xFFFFFFFF).view(np.int32)
+    dts_i[row, 1] = np.int32(s >> 32)
+    dts_i[row, 2] = nn
+    dfl_i[row] = 3  # live | counted
+pub_raw_i = ec._pub_raw(pubs_i, M_I)
+thr0_i = np.zeros((1, ek.TALLY_LIMBS), np.int32)
+oracle_rows = np.asarray(ec._stamp_rows_jit(
+    jnp.asarray(sig_i), jnp.asarray(dts_i), jnp.asarray(dfl_i),
+    ent_i.pre_mat, ent_i.pre_len, ent_i.suf_mat, ent_i.suf_len,
+    ent_i.ts_tag, pub_raw_i, jnp.asarray(thr0_i),
+    msg_max=ent_i.msg_max, t_rows=1))
+step_i = pm.sharded_stamp_rows(mesh4b, ent_i.msg_max)
+shard_rows = np.asarray(step_i(
+    sig_i, dts_i, dfl_i,
+    np.asarray(ent_i.pre_mat), np.asarray(ent_i.pre_len),
+    np.asarray(ent_i.suf_mat), np.asarray(ent_i.suf_len),
+    np.asarray(ent_i.ts_tag), np.asarray(pub_raw_i)))
+np.testing.assert_array_equal(shard_rows, oracle_rows)
+assert shard_rows.any(), "stamped phase produced all-zero rows"
+
 print(json.dumps({
     "ok": True,
+    "stamped_shards_ok": True,
     "devices": len(jax.devices()),
     "verdicts": len(verd_m),
     "sharded_flushes": summary["shard"]["flushes"],

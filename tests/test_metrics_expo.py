@@ -130,7 +130,10 @@ def _populated_node_metrics() -> NodeMetrics:
     m.plane_wait_seconds.observe(0.003)
     m.plane_padding_waste.inc(4)
     m.plane_pack_seconds.observe(0.0004)
-    m.plane_h2d_bytes.inc(4096)
+    # split by path since the device-stamping PR: "device" = per-row
+    # delta buffers, "host" = full packed rows
+    m.plane_h2d_bytes.inc(4096, path="host")
+    m.plane_h2d_bytes.inc(80, path="device")
     m.mempool_size.set(9)
     m.peers.set(3)
     m.blocksync_syncing.set(0)
@@ -165,6 +168,11 @@ def test_full_nodemetrics_promtext_roundtrip():
     steps = {s[1].get("step") for s in
              fams["cometbft_consensus_step_duration_seconds"]["samples"]}
     assert {"propose", "prevote"} <= steps
+    # the h2d counter's path split (device stamping PR) survives the
+    # round trip with both series intact
+    h2d = {s[1].get("path"): s[2] for s in
+           fams["cometbft_verifyplane_h2d_bytes_total"]["samples"]}
+    assert h2d == {"host": 4096, "device": 80}
 
 
 def test_idle_histograms_expose_zero_rows():

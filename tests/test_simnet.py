@@ -110,6 +110,49 @@ def test_template_packing_determinism_vs_legacy(tmp_path):
     assert run_once("tmpl", True) == run_once("legacy", False)
 
 
+def test_device_stamping_toggle_determinism(tmp_path):
+    """ISSUE 19 satellite: the same (seed, schedule) with device
+    stamping enabled vs disabled yields commit hashes byte-identical
+    at every height on every node, with a RUNNING verify plane
+    mounted. The delta arm exercises the whole new seam — vote_set
+    attaches per-row (template, secs, nanos) stamp metadata to every
+    plane submission and requests a template prefetch — and on a
+    host-path plane the flush must degrade to the host pack honestly
+    (every ledger record's stamp column says "host"): metadata that
+    perturbed packing, verdicts, or scheduling would fork the runs or
+    wedge a round."""
+    from cometbft_tpu.verifyplane import VerifyPlane, set_global_plane
+    from cometbft_tpu.verifyplane import fused as fz
+
+    sched = [
+        {"at": 0.05, "op": "link", "drop": 0.04, "delay": 0.01,
+         "jitter": 0.005},
+        {"at": 0.3, "op": "tx", "node": 1, "data": b"de=lta".hex()},
+    ]
+
+    def run_once(tag, on):
+        prev = fz.DEVICE_STAMP
+        fz.set_device_stamping(on)
+        plane = VerifyPlane(window_ms=0.5, use_device=False)
+        plane.start()
+        set_global_plane(plane)
+        try:
+            with Simnet(4, seed=91, basedir=str(tmp_path / tag)) as sim:
+                assert sim.run(sched, until_height=2, max_time=120.0)
+                sim.assert_safety()
+                hashes = sim.commit_hashes()
+        finally:
+            set_global_plane(None)
+            plane.stop()
+            fz.set_device_stamping(prev)
+        assert plane.rows_verified > 0  # votes really rode the plane
+        recs = plane.dump_flushes()["flushes"]
+        assert recs and all(r["stamp"] == "host" for r in recs), recs
+        return hashes
+
+    assert run_once("stamp", True) == run_once("legacy", False)
+
+
 def test_partition_minority_stalls_then_catches_up(tmp_path):
     """A partitioned validator cannot commit (safety) while the 3/4
     majority keeps going; after heal the catch-up pushes restore it."""

@@ -36,7 +36,7 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 12  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 13  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
@@ -45,8 +45,9 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
                                "cfg12_smoke", "cfg13_smoke",
                                "cfg14_smoke", "cfg15_smoke",
                                "cfg16_smoke", "cfg17_smoke",
-                               "cfg18_smoke", "cfg2_smoke",
-                               "cfg4_smoke", "cfg6_smoke"]
+                               "cfg18_smoke", "cfg19_smoke",
+                               "cfg2_smoke", "cfg4_smoke",
+                               "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -114,6 +115,16 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert cu["reverified_after_resume"] == 0
     assert cu["catchup_dump"]["records"], cu["catchup_dump"]
     assert cu["catchup_dump"]["counters"]["resumes"] >= 1
+    # the cfg19 miniature proved the delta-staging shrink (>=4x fewer
+    # bytes on the bus than full-row packing at the 10k-row shape),
+    # delta-vs-patch byte equality, and the ledger's stamp attribution
+    ds = results["cfg19_smoke"]
+    assert ds["value"] >= 4.0
+    assert ds["extra"]["byte_equality"] is True
+    assert ds["extra"]["staged_bytes_delta"] < \
+        ds["extra"]["staged_bytes_legacy"]
+    assert ds["extra"]["ledger_stamp"]["device"] == 1
+    assert ds["extra"]["ledger_stamp"]["host"] == 1
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

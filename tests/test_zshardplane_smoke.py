@@ -71,3 +71,6 @@ def test_sharded_plane_matches_single_device_on_forced_4dev_host():
     assert obs["storm_fired"] >= 1
     assert obs["paid_flush_comp_ms"] > 0
     assert 0 < obs["sharded_util"] <= 1.0
+    # ISSUE 19: each device stamped its own rows slice from per-row
+    # deltas bit-identically to the single-device expansion
+    assert rep["stamped_shards_ok"] is True
