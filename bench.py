@@ -3454,6 +3454,260 @@ def cfg19_device_stamp(n_vals=2048, reps=5, n_flushes=12):
     }
 
 
+def cost_hooks_bookkeeping_us(k: int = 20_000) -> dict:
+    """Per-flush cost of the ISSUE 20 cost-observatory hooks with
+    tracing disabled (< 10 us/flush, tier-1-asserted).
+
+    Replays the exact sequence _charge_flush adds to every flush — one
+    split_device_columns call over a fused three-tenant batch (the
+    worst common case: integer shares plus the last-tenant residual),
+    the per-share note_device accumulation, and the cost-surface
+    observe() bucketing — against throwaway registry/surface instances
+    so the session's live observatory is untouched."""
+    from cometbft_tpu.libs import deviceledger, tracing
+    from cometbft_tpu.verifyplane.plane import split_device_columns
+    from cometbft_tpu.verifyplane.tenants import TenantRegistry
+
+    assert not tracing.enabled(), "measure the DISABLED path"
+    reg = TenantRegistry()
+    surf = deviceledger.CostSurfaces()
+    tens = (("bench-a", 24), ("bench-b", 24), ("bench-c", 16))
+    t0 = _now_ms()
+    for _ in range(k):
+        rule, shares = split_device_columns(tens, 64, 1.25, 0.5,
+                                            3.75, 5121)
+        reg.note_device_shares(shares)
+        surf.observe("fused:stamped", 64, 1, 1.25, 0.5, 3.75)
+    hook_us = (_now_ms() - t0) * 1000 / k
+    return {
+        "cost_hooks_us_per_flush": round(hook_us, 3),
+        "note": "tenant split + per-share charge + cost-surface "
+                "bucket, per flush; always-on (<10us budget)",
+    }
+
+
+def smoke_cost_observatory():
+    """cfg20's host-only miniature (no jax, no plane): the cost
+    observatory's arithmetic proven in isolation — the tenant split
+    rule (exact at sub-flush boundaries, row-proportional with an
+    integer last-tenant residual inside a fused batch), charge
+    conservation across eviction/retirement (reconcile_device drift
+    identically zero — integer us, no tolerance band), the
+    rows-bucket / percentile / marginal-slope math of the cost
+    surfaces, the CostModel estimate extension past the learned
+    range, and the always-on per-flush hook budget."""
+    from cometbft_tpu.libs import deviceledger
+    from cometbft_tpu.verifyplane.plane import (
+        SPLIT_EXACT,
+        SPLIT_ROWS,
+        ms_to_us,
+        split_device_columns,
+    )
+    from cometbft_tpu.verifyplane.tenants import (
+        TenantRegistry,
+        reconcile_device,
+    )
+
+    checks = {}
+    # the split rule: nothing charged without tenants, full charge for
+    # a single tenant, row-proportional shares that conserve EVERY
+    # column exactly (the residual lands on the last tenant)
+    checks["empty_tenants_no_charge"] = split_device_columns(
+        (), 0, 1.0, 1.0, 1.0, 64) == (SPLIT_EXACT, [])
+    rule, shares = split_device_columns(
+        (("a", 64),), 64, 1.25, 0.5, 3.75, 5120)
+    checks["single_tenant_exact"] = (
+        rule == SPLIT_EXACT
+        and shares == [("a", 1250, 500, 3750, 5120)])
+    rule, shares = split_device_columns(
+        (("a", 24), ("b", 24), ("c", 16)), 64, 1.25, 0.5, 3.75, 5121)
+    checks["fused_rows_rule"] = rule == SPLIT_ROWS
+    checks["fused_conserves_every_column"] = all(
+        sum(s[i] for s in shares) == tot
+        for i, tot in ((1, ms_to_us(1.25)), (2, ms_to_us(0.5)),
+                       (3, ms_to_us(3.75)), (4, 5121)))
+
+    # conservation across eviction: charge a registry from synthetic
+    # ledger records, reconcile (drift zero), retire one tenant, and
+    # reconcile again — the retired fold must keep the totals exact
+    reg = TenantRegistry()
+    recs = [
+        {"tenants": (("a", 8),), "rows": 8, "comp_ms": 2.0,
+         "h2d_ms": 0.25, "dev_ms": 1.5, "delta_bytes": 640},
+        {"tenants": (("a", 30), ("b", 34)), "rows": 64,
+         "comp_ms": 0.0, "h2d_ms": 0.125, "dev_ms": 3.125,
+         "delta_bytes": 5120},
+        # shed-only record: () tenants, never charged
+        {"tenants": (), "rows": 16, "comp_ms": 9.0, "h2d_ms": 9.0,
+         "dev_ms": 9.0, "delta_bytes": 999},
+    ]
+    for r in recs:
+        if r["tenants"]:
+            _, sh = split_device_columns(
+                r["tenants"], r["rows"], r["comp_ms"], r["h2d_ms"],
+                r["dev_ms"], r["delta_bytes"])
+            for chain, comp_us, h2d_us, dev_us, dbytes in sh:
+                reg.note_device(chain, comp_us, h2d_us, dev_us, dbytes)
+    checks["conservation"] = all(
+        v == 0 for v in reconcile_device(recs, reg)["drift"].values())
+    reg.evict("a")
+    checks["conservation_after_retirement"] = all(
+        v == 0 for v in reconcile_device(recs, reg)["drift"].values())
+    checks["retired_fold"] = reg.dump()["retired"]["device_us"] > 0
+
+    # cost-bucket math against an isolated recorder: power-of-two
+    # buckets, sorted surfaces, the marginal slope between adjacent
+    # buckets, and the estimate extension past the learned range
+    checks["bucket_boundaries"] = (
+        [deviceledger.rows_bucket(n) for n in (0, 1, 2, 3, 64, 65)]
+        == [1, 1, 2, 4, 64, 128])
+    prev = deviceledger.install_surfaces(deviceledger.CostSurfaces())
+    try:
+        for rows, dev in ((8, 0.6), (64, 1.1), (512, 4.0)):
+            for _ in range(5):
+                deviceledger.observe_flush(
+                    "fused", "device", rows, 1, 0.0, 0.1, dev)
+        cs = deviceledger.surfaces().surfaces()
+        p50s = [r["dev_ms_p50"] for r in cs]
+        checks["surfaces_populated"] = len(cs) == 3
+        checks["stamped_family_label"] = all(
+            r["family"] == "fused:stamped" for r in cs)
+        checks["monotone_dev_p50"] = p50s == sorted(p50s)
+        checks["marginal_math"] = (
+            cs[1]["marginal_ms_per_row"]
+            == round((1.1 - 0.6) / (64 - 8), 6))
+        model = deviceledger.cost_model()
+        checks["estimate_extends"] = (
+            model.estimate_dev_ms("fused:stamped", 2000) is not None
+            and model.estimate_dev_ms("unobserved", 64) is None)
+    finally:
+        deviceledger.install_surfaces(prev)
+
+    budget = cost_hooks_bookkeeping_us(k=2000)
+    checks["hook_budget"] = budget["cost_hooks_us_per_flush"] < 10.0
+    assert all(checks.values()), checks
+    return {
+        "metric": "cfg20_smoke cost observatory hooks",
+        "value": budget["cost_hooks_us_per_flush"],
+        "unit": "us/flush",
+        "vs_baseline": None,
+        "extra": {"checks": checks, "budget": budget,
+                  "surfaces_sample": cs},
+    }
+
+
+def cfg20_cost_pod(rounds=6, row_sizes=(12, 96, 768)):
+    """#20: the cost observatory end to end — K chains at DISTINCT
+    flush shapes through one shared plane, so the per-flush hook
+    populates separated rows-buckets of the cost surfaces while the
+    tenant registry accrues each chain's device charge. Sequential
+    per-chain rounds give each shape its own bucket; a final
+    concurrent round coalesces cross-tenant rows into a fused flush
+    and exercises the row-proportional split. The row sizes sit
+    MID-bucket (12->16, 96->128, 768->1024) so any cross-tenant
+    fusion lands in the largest member's own bucket with MORE rows —
+    coalescing can only pull a bucket's p50 up, never park a
+    bottom-of-bucket flush under the previous bucket's top. Evidence:
+    (a) reconcile_device drift is exactly zero against the flush
+    ledger; (b) cost_surfaces is non-empty with dev p50 monotone
+    non-decreasing across rows-buckets within each (family, n_dev)
+    series; (c) the embedded tenants_dump / devices_dump are the
+    tenant_report / device_report inputs."""
+    from cometbft_tpu.crypto.keys import PrivKey
+    from cometbft_tpu.libs import deviceledger
+    from cometbft_tpu.verifyplane.plane import (
+        LANE_BULK,
+        SPLIT_ROWS,
+        VerifyPlane,
+    )
+    from cometbft_tpu.verifyplane.tenants import reconcile_device
+
+    chains = {}
+    for i, n in enumerate(row_sizes):
+        chain = f"cost-{n}"
+        msg = b"cfg20:" + chain.encode()
+        rows = []
+        for j in range(n):
+            priv = PrivKey.generate(
+                bytes([210 + i]) + j.to_bytes(2, "big") + b"\x44" * 29)
+            rows.append((priv.pub_key(), msg, priv.sign(msg)))
+        chains[chain] = rows
+
+    prev = deviceledger.install_surfaces(deviceledger.CostSurfaces())
+    plane = VerifyPlane(window_ms=0.5, use_device=False,
+                        max_batch=2 * sum(row_sizes))
+    plane.start()
+    t = _now_ms()
+    try:
+        for _ in range(rounds):
+            for c, rows in chains.items():
+                assert all(plane.submit_many(
+                    list(rows), lane=LANE_BULK,
+                    chain_id=c).result(60.0))
+        futs = [plane.submit_many(list(rows), lane=LANE_BULK,
+                                  chain_id=c)
+                for c, rows in chains.items()]
+        for f in futs:
+            assert all(f.result(60.0))
+        wall_ms = _now_ms() - t
+        recs = plane.ledger.records()
+        rd = reconcile_device(recs, plane.tenants)
+        tenants_dump = plane.tenants.dump()
+        devices_dump = deviceledger.dump_devices()
+        cs = devices_dump["cost_surfaces"]
+        model = deviceledger.cost_model()
+    finally:
+        plane.stop()
+        deviceledger.install_surfaces(prev)
+
+    series = {}
+    for r in cs:
+        series.setdefault((r["family"], r["n_dev"]), []).append(
+            (r["rows_bucket"], r["dev_ms_p50"]))
+    fam0 = cs[0]["family"] if cs else ""
+    checks = {
+        "conservation_drift_zero": all(
+            v == 0 for v in rd["drift"].values()),
+        "surfaces_nonempty": len(cs) >= len(row_sizes),
+        "buckets_separated": len({r["rows_bucket"] for r in cs})
+        >= len(row_sizes),
+        "monotone_dev_p50": all(
+            p[1] <= q[1]
+            for pts in series.values()
+            for p, q in zip(sorted(pts), sorted(pts)[1:])),
+        "fused_split_recorded": any(
+            r["split"] == SPLIT_ROWS for r in recs
+            if len(r["tenants"]) > 1),
+        "every_flush_observed":
+            devices_dump["cost_counters"]["observed"] >= len(recs),
+        "estimate_available": bool(cs) and model.estimate_dev_ms(
+            fam0, row_sizes[0]) is not None,
+    }
+    assert all(checks.values()), checks
+    total_rows = (rounds + 1) * sum(row_sizes)
+    budget = cost_hooks_bookkeeping_us()
+    return {
+        "metric": "cfg20 cost-observatory pod throughput",
+        "value": round(total_rows / max(wall_ms, 1e-9) * 1000.0, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "extra": {
+            "rows_total": total_rows,
+            "flushes": len(recs),
+            "split_rules": {
+                rule: sum(1 for r in recs if r["split"] == rule)
+                for rule in {r["split"] for r in recs}},
+            "reconcile": rd,
+            "cost_counters": devices_dump["cost_counters"],
+            "cost_surfaces": cs,
+            "budget": budget,
+            "checks": checks,
+            "tenants_dump": tenants_dump,
+            "devices_dump": devices_dump,
+        },
+    }
+
+
 SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg4_smoke", smoke_pack_rows),
                  ("cfg6_smoke", smoke_vote_plane),
@@ -3466,7 +3720,8 @@ SMOKE_CONFIGS = [("cfg2_smoke", smoke_commit_verify),
                  ("cfg16_smoke", smoke_controller),
                  ("cfg17_smoke", smoke_tenants),
                  ("cfg18_smoke", smoke_catchup),
-                 ("cfg19_smoke", smoke_device_stamp)]
+                 ("cfg19_smoke", smoke_device_stamp),
+                 ("cfg20_smoke", smoke_cost_observatory)]
 
 TRACED_CONFIGS = ("cfg2", "cfg6")  # flush-pipeline configs worth a trace
 
@@ -3484,7 +3739,8 @@ FULL_CONFIGS = [("cfg1", cfg1_live_node), ("cfg2", cfg2_1k_commit),
                 ("cfg15", cfg15_device), ("cfg16", cfg16_controller),
                 ("cfg17", cfg17_tenants),
                 ("cfg18", cfg18_catchup),
-                ("cfg19", cfg19_device_stamp)]
+                ("cfg19", cfg19_device_stamp),
+                ("cfg20", cfg20_cost_pod)]
 FULL_CONFIG_NAMES = [name for name, _ in FULL_CONFIGS] + ["headline"]
 
 

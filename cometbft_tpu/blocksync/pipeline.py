@@ -281,8 +281,10 @@ class StreamVerifier:
                 job.commit.height, job.commit.round,
                 job.commit.block_id)
             sites.append(tpl.stamp_site())
-        sec_a = np.array([s for s, _ in row_ts], np.int64)
-        nan_a = np.array([nn for _, nn in row_ts], np.int64)
+        sec_a = np.fromiter((s for s, _ in row_ts), np.int64,
+                            count=len(row_ts))
+        nan_a = np.fromiter((nn for _, nn in row_ts), np.int64,
+                            count=len(row_ts))
         try:
             ent = ec.template_entry(sites)
         except Exception:  # noqa: BLE001 - oversized site: host pack
@@ -292,10 +294,7 @@ class StreamVerifier:
         dsig[pos] = np.frombuffer(b"".join(sigs),
                                   np.uint8).reshape(-1, 64)
         dts = pool.get("chunk.dts", (B, 3), np.int32)
-        dts[pos, 0] = (sec_a & 0xFFFFFFFF).astype(np.uint32) \
-            .view(np.int32)
-        dts[pos, 1] = (sec_a >> 32).astype(np.int32)
-        dts[pos, 2] = nan_a.astype(np.int32)
+        dts[pos] = canonical.split_ts_words(sec_a, nan_a)
         dfl = pool.get("chunk.dflags", (B,), np.int32)
         rj = np.asarray(row_job, np.int64)
         # live | counted | tmpl_id<<2 | cid<<10 — every packed chunk

@@ -517,6 +517,195 @@ def reconcile(fams: Optional[Dict] = None) -> dict:
 
 
 # --------------------------------------------------------------------------
+# kernel cost surfaces (ISSUE 20): an always-on bounded recorder that
+# buckets every flush observation into per-(jit family, rows-bucket,
+# n_dev) cost curves. The families are the flush ledger's path labels
+# (fused / fused_sharded / grouped / host ...), split by stamp origin
+# (":stamped" = the device-side sign-bytes path compiles a different
+# kernel than legacy full-row packing) — exactly the jit identity the
+# plane dispatches under. ROADMAP item 6's future multi-SLO arbiter
+# and item 3's EdDSA-vs-BLS curve chooser read cost_model(); operators
+# read the cost_surfaces table on /dump_devices.
+# --------------------------------------------------------------------------
+
+# bounded: a handful of path families x ~a dozen power-of-two rows
+# buckets x small n_dev set. 128 cells is generous headroom; FIFO
+# eviction past it (cells are cheap to re-learn).
+COST_CELLS_MAX = 128
+# per-cell sample window: enough for stable p50/p95, bounded memory
+COST_SAMPLES_PER_CELL = 64
+
+
+def rows_bucket(rows: int) -> int:
+    """The rows-bucket a flush observation lands in: the next power of
+    two >= rows (jit recompiles on shape, and the plane's padding
+    quantizes shapes the same way — observations inside one bucket hit
+    one compiled kernel)."""
+    rows = int(rows)
+    if rows <= 1:
+        return 1
+    return 1 << (rows - 1).bit_length()
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+class CostSurfaces:
+    """Bounded per-(family, rows_bucket, n_dev) flush-cost cells. The
+    observe path is the plane's per-flush hook (always on, inside the
+    10 us budget bench.cost_hooks_bookkeeping_us asserts); percentiles
+    and marginal-cost fits happen at READ time only."""
+
+    __slots__ = ("_cells", "_lock", "observed", "dropped_cells")
+
+    def __init__(self):
+        # (family, bucket, n_dev) -> [count, rows_total, comp_dq,
+        #                             h2d_dq, dev_dq]
+        self._cells: Dict = {}
+        self._lock = threading.Lock()
+        self.observed = 0
+        self.dropped_cells = 0
+
+    def observe(self, family: str, rows: int, n_dev: int,
+                comp_ms: float, h2d_ms: float, dev_ms: float) -> None:
+        key = (family, rows_bucket(rows), int(n_dev))
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                if len(self._cells) >= COST_CELLS_MAX:
+                    # FIFO past the cap: drop the oldest-inserted cell
+                    self._cells.pop(next(iter(self._cells)))
+                    self.dropped_cells += 1
+                cell = self._cells[key] = [
+                    0, 0,
+                    deque(maxlen=COST_SAMPLES_PER_CELL),
+                    deque(maxlen=COST_SAMPLES_PER_CELL),
+                    deque(maxlen=COST_SAMPLES_PER_CELL)]
+            cell[0] += 1
+            cell[1] += int(rows)
+            cell[2].append(float(comp_ms))
+            cell[3].append(float(h2d_ms))
+            cell[4].append(float(dev_ms))
+            self.observed += 1
+
+    def surfaces(self) -> List[dict]:
+        """The cost_surfaces table: one row per live cell, sorted by
+        (family, n_dev, rows_bucket), with comp/h2d/dev percentiles
+        and the marginal dev-ms-per-row slope between this bucket and
+        the previous one in the same (family, n_dev) series — the
+        number a capacity planner multiplies rows by."""
+        with self._lock:
+            snap = {k: (c[0], c[1], list(c[2]), list(c[3]), list(c[4]))
+                    for k, c in self._cells.items()}
+        rows_out: List[dict] = []
+        prev: Dict = {}
+        for (fam, bucket, n_dev) in sorted(snap):
+            n, rows_total, comp, h2d, dev = snap[(fam, bucket, n_dev)]
+            comp.sort(), h2d.sort(), dev.sort()
+            dev_p50 = _pct(dev, 0.50)
+            row = {
+                "family": fam, "rows_bucket": bucket, "n_dev": n_dev,
+                "n": n, "rows_total": rows_total,
+                "comp_ms_p50": round(_pct(comp, 0.50), 3),
+                "comp_ms_p95": round(_pct(comp, 0.95), 3),
+                "h2d_ms_p50": round(_pct(h2d, 0.50), 3),
+                "h2d_ms_p95": round(_pct(h2d, 0.95), 3),
+                "dev_ms_p50": round(dev_p50, 3),
+                "dev_ms_p95": round(_pct(dev, 0.95), 3),
+                "marginal_ms_per_row": None,
+            }
+            last = prev.get((fam, n_dev))
+            if last is not None and bucket > last[0]:
+                row["marginal_ms_per_row"] = round(
+                    (dev_p50 - last[1]) / (bucket - last[0]), 6)
+            prev[(fam, n_dev)] = (bucket, dev_p50)
+            rows_out.append(row)
+        return rows_out
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"observed": self.observed,
+                    "cells": len(self._cells),
+                    "dropped_cells": self.dropped_cells}
+
+
+_SURFACES = CostSurfaces()
+
+
+def surfaces() -> CostSurfaces:
+    return _SURFACES
+
+
+def install_surfaces(s: CostSurfaces) -> CostSurfaces:
+    """Swap the global recorder (tests/bench isolation); returns the
+    previous one — the install() pattern, applied to cost cells."""
+    global _SURFACES
+    old = _SURFACES
+    _SURFACES = s
+    return old
+
+
+def observe_flush(path: str, stamp: str, rows: int, n_dev: int,
+                  comp_ms: float, h2d_ms: float, dev_ms: float) -> None:
+    """The plane's per-flush seam: derive the jit-family label from the
+    flush path + stamp origin and record one observation. Kept module-
+    level (not a method call off the plane) so bench and the jax-free
+    smoke drive the identical code the hot path runs."""
+    fam = path + ":stamped" if stamp == "device" else path
+    _SURFACES.observe(fam, rows, max(1, int(n_dev)),
+                      comp_ms, h2d_ms, dev_ms)
+
+
+class CostModel:
+    """Programmatic read API over one surfaces() snapshot: the
+    consumer-side object ROADMAP item 6's arbiter (may a loop touch
+    window_ms / lane quanta / mesh_min_rows?) and item 3's kernel
+    chooser interrogate. Snapshot semantics: build once, query many."""
+
+    __slots__ = ("_rows",)
+
+    def __init__(self, rows: List[dict]):
+        self._rows = rows
+
+    def families(self) -> List[str]:
+        return sorted({r["family"] for r in self._rows})
+
+    def curve(self, family: str, n_dev: int = 1) -> List[dict]:
+        """The (rows_bucket ascending) cost curve of one jit family."""
+        return [r for r in self._rows
+                if r["family"] == family and r["n_dev"] == int(n_dev)]
+
+    def estimate_dev_ms(self, family: str, rows: int,
+                        n_dev: int = 1) -> Optional[float]:
+        """p50 device-ms estimate for a flush of `rows`: the matching
+        bucket's p50, linearly extended by the last marginal slope when
+        `rows` lands past the learned range. None when the family has
+        no observations yet — the caller's cue that a knob may NOT be
+        touched (the item-6 contract: no cost model, no actuation)."""
+        curve = self.curve(family, n_dev)
+        if not curve:
+            return None
+        b = rows_bucket(rows)
+        for r in curve:
+            if r["rows_bucket"] >= b:
+                return r["dev_ms_p50"]
+        last = curve[-1]
+        slope = last["marginal_ms_per_row"] or 0.0
+        return round(last["dev_ms_p50"]
+                     + slope * (b - last["rows_bucket"]), 3)
+
+
+def cost_model() -> CostModel:
+    """Snapshot the live cost surfaces into a queryable CostModel."""
+    return CostModel(_SURFACES.surfaces())
+
+
+# --------------------------------------------------------------------------
 # the /dump_devices document
 # --------------------------------------------------------------------------
 
@@ -549,6 +738,8 @@ def dump_devices() -> dict:
                           for d, n in headroom_rows(fams).items()},
         "hbm_slot_budget": HBM_SLOT_BUDGET,
         "reconcile": rec,
+        "cost_surfaces": _SURFACES.surfaces(),
+        "cost_counters": _SURFACES.counters(),
         "flushes": None,
     }
     doc["summary"]["resident_bytes"] = sum(

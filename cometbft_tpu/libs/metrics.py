@@ -571,6 +571,13 @@ class NodeMetrics:
             "deadline/overload sheds attributed to the submitting "
             "chain (label tenant; same top-K + _retired bound as "
             "tenant_rows_total)")
+        self.tenant_device_ms = r.counter(
+            "verifyplane", "tenant_device_ms_total",
+            "Device milliseconds the verify plane's flushes charged "
+            "per tenant chain — each flush's dev_ms split across its "
+            "tenants column (exact at sub-flush boundaries, "
+            "row-proportional within a fused batch; label tenant; "
+            "same top-K + _retired bound as tenant_rows_total)")
         self.tenant_registry_size = r.gauge(
             "verifyplane", "tenant_registry_size",
             "Chains currently registered with the verify plane's "
@@ -869,11 +876,16 @@ class NodeMetrics:
                     key = (("tenant", name),)
                     self.tenant_rows._set(key, float(row["rows"]))
                     self.tenant_sheds._set(key, float(row["sheds"]))
+                    self.tenant_device_ms._set(
+                        key, float(row["device_ms"]))
                 ret = mr["retired"]
                 self.tenant_rows._set((("tenant", "_retired"),),
                                       float(ret["rows"]))
                 self.tenant_sheds._set((("tenant", "_retired"),),
                                        float(ret["sheds"]))
+                self.tenant_device_ms._set(
+                    (("tenant", "_retired"),),
+                    round(ret["device_us"] / 1000.0, 3))
                 self.tenant_registry_size.set(
                     float(mr["registry_size"]))
                 # gauge: stale tenants must vanish, not freeze (the

@@ -189,6 +189,28 @@ class StampSite:
         return (self.pre.tobytes(), self.suf.tobytes(), self.ts_tag)
 
 
+def split_ts_words(secs, nanos, out: Optional[np.ndarray] = None
+                   ) -> np.ndarray:
+    """(n,) secs + (n,) nanos -> (n, 3) int32 staged delta words
+    [secs_lo, secs_hi, nanos]: unsigned lo word (int32 view) +
+    arithmetic-shift hi word, nanos in their own word. THE word-split
+    kernel of the delta staging layout — DeltaRows.ts_words, the fused
+    planner, and blocksync's chunk stamping all stage through this one
+    vectorized pass (ROADMAP item 8: the host_pack_stamped_ms residual
+    must carry no Python-loop byte math). Accepts any int sequence;
+    ``out`` reuses a caller buffer (a staging-pool row slice)."""
+    secs = np.ascontiguousarray(secs, np.int64)
+    nanos = np.asarray(nanos, np.int64)
+    if out is None:
+        out = np.empty((secs.shape[0], 3), np.int32)
+    u = secs.view(np.uint64)
+    out[:, 0] = (u & np.uint64(0xFFFFFFFF)).astype(
+        np.uint32).view(np.int32)
+    out[:, 1] = (secs >> np.int64(32)).astype(np.int32)
+    out[:, 2] = nanos.astype(np.int32)
+    return out
+
+
 class DeltaRows:
     """The compact per-row delta form of a vote batch: one int64
     secs/nanos pair per row against a shared VoteRowTemplate, not full
@@ -224,14 +246,7 @@ class DeltaRows:
         nanos]. secs splits as unsigned lo word + arithmetic-shift hi
         word; the device prologue reassembles the 64-bit value from
         the pair and sign-extends nanos from its single word."""
-        out = np.empty((self.secs.shape[0], 3), np.int32)
-        u = self.secs.view(np.uint64) if self.secs.dtype == np.int64 \
-            else np.asarray(self.secs, np.int64).view(np.uint64)
-        out[:, 0] = (u & np.uint64(0xFFFFFFFF)).astype(
-            np.uint32).view(np.int32)
-        out[:, 1] = (self.secs >> np.int64(32)).astype(np.int32)
-        out[:, 2] = self.nanos.astype(np.int32)
-        return out
+        return split_ts_words(self.secs, self.nanos)
 
     @property
     def nbytes(self) -> int:

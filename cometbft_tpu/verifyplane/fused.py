@@ -327,6 +327,7 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
     from cometbft_tpu.ops import ed25519_cached as ec
     from cometbft_tpu.ops import ed25519_kernel as ek
     from cometbft_tpu.ops.ed25519_pallas import _PB
+    from cometbft_tpu.types import canonical
 
     # slot assignment: first free stride wins (a validator's vote and
     # its extension land in different strides); positions are computed
@@ -436,15 +437,11 @@ def plan_fused(batch, pool=None, mesh=None, half=None,
         # zero fill makes unoccupied lanes live=0, which the prologue
         # expands to the same all-zero columns host packing pads with.
         sites, site_ids = stamp
-        sec_a = np.array([st[1] for st in stamp_meta], np.int64)
-        nan_a = np.array([st[2] for st in stamp_meta], np.int64)
-        ts_rows = np.empty((n, 3), np.int32)
-        # the DeltaRows.ts_words split: unsigned lo word (int32 view) +
-        # arithmetic-shift hi word; nanos ride their own word
-        ts_rows[:, 0] = (sec_a & 0xFFFFFFFF).astype(np.uint32) \
-            .view(np.int32)
-        ts_rows[:, 1] = (sec_a >> 32).astype(np.int32)
-        ts_rows[:, 2] = nan_a.astype(np.int32)
+        sec_a = np.fromiter((st[1] for st in stamp_meta), np.int64,
+                            count=n)
+        nan_a = np.fromiter((st[2] for st in stamp_meta), np.int64,
+                            count=n)
+        ts_rows = canonical.split_ts_words(sec_a, nan_a)
         fl_rows = np.ones((n,), np.int32)
         fl_rows |= np.asarray(site_ids, np.int32) << 2
         fl_rows |= np.asarray(row_gid, np.int32) << 10

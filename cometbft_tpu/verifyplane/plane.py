@@ -165,6 +165,18 @@ PATH_SHED_ONLY = "shed_only"        # drain cycle that only shed (no flush)
 STAMP_DEVICE = "device"
 STAMP_HOST = "host"
 
+# per-flush tenant split rule (the ledger's ``split`` column): how the
+# flush's device-time columns (comp_ms/h2d_ms/dev_ms/delta_bytes) were
+# charged to its ``tenants`` — "exact" when one tenant owned every row
+# (the sub-flush boundary case: the fair-share drain's per-tenant row
+# slices make the charge exact by construction), "rows" when a fused
+# batch coalesced several tenants and the charge is row-proportional
+# (the only defensible split inside ONE device pass). Recorded per
+# flush so an operator reading /dump_tenants device columns knows
+# which rule produced each number.
+SPLIT_EXACT = "exact"
+SPLIT_ROWS = "rows"
+
 # Record-field indices. A flush's record is ONE list allocated at stage
 # time in FIELDS order (plus two trailing internal ns stamps the readers
 # never see); the dispatcher mutates it in place as stages land and the
@@ -174,11 +186,64 @@ STAMP_HOST = "host"
  _L_COLLECT, _L_SETTLE, _L_AIR, _L_PATH, _L_STAMP, _L_BRK, _L_SMISS,
  _L_DEPTH, _L_CROWS, _L_GROWS, _L_BROWS, _L_SHED, _L_NDEV,
  _L_NHOST, _L_DEV0, _L_WARM, _L_COMP, _L_H2D, _L_DBYTES, _L_DEV,
- _L_UTIL, _L_TEN) = range(29)
+ _L_UTIL, _L_TEN, _L_SPLIT) = range(30)
 # internal slots past the FIELDS window: ns stamps + the clock
 # generation they were taken under + the first-ready probe stamp
 # (readers never see these)
-_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 29, 30, 31, 32
+_L_T0NS, _L_TPACKED, _L_GEN, _L_READY = 30, 31, 32, 33
+
+
+def ms_to_us(ms) -> int:
+    """Ledger-ms (rounded to 3 decimals) -> exact integer microseconds.
+
+    The per-tenant device accounting and its conservation cross-check
+    (tenants.reconcile_device) run on INTEGER microseconds so the
+    exact-accounting contract holds with no float tolerance band — a
+    3-decimal ms value is a whole number of us by construction."""
+    return int(round(float(ms) * 1000.0))
+
+
+def split_device_columns(tenants: tuple, rows: int, comp_ms, h2d_ms,
+                         dev_ms, delta_bytes: int):
+    """Split one flush's device-time columns across its tenant pairs.
+
+    Returns (rule, [(chain, comp_us, h2d_us, dev_us, delta_bytes)]):
+    one tenant (or an empty/rowless flush) is charged EXACTLY; a fused
+    multi-tenant batch splits row-proportionally with the LAST tenant
+    taking the integer residual, so the shares always sum back to the
+    flush totals with zero drift (the HBM _split_exact discipline
+    applied to time). Pure arithmetic — cfg20's smoke drives it with
+    no jax in the process."""
+    comp_us = ms_to_us(comp_ms)
+    h2d_us = ms_to_us(h2d_ms)
+    dev_us = ms_to_us(dev_ms)
+    dbytes = int(delta_bytes)
+    if not tenants:
+        return SPLIT_EXACT, []
+    if len(tenants) == 1 or rows <= 0:
+        chain = tenants[0][0]
+        return SPLIT_EXACT, [(chain, comp_us, h2d_us, dev_us, dbytes)]
+    # unrolled columns (no per-share tuple comprehensions): this runs
+    # inside the per-flush hook budget bench.cost_hooks_bookkeeping_us
+    # asserts, so the constant factor matters
+    out = []
+    c_acc = h_acc = d_acc = b_acc = 0
+    last = len(tenants) - 1
+    for i, (chain, t_rows) in enumerate(tenants):
+        if i == last:
+            out.append((chain, comp_us - c_acc, h2d_us - h_acc,
+                        dev_us - d_acc, dbytes - b_acc))
+        else:
+            c = comp_us * t_rows // rows
+            h = h2d_us * t_rows // rows
+            d = dev_us * t_rows // rows
+            b = dbytes * t_rows // rows
+            c_acc += c
+            h_acc += h
+            d_acc += d
+            b_acc += b
+            out.append((chain, c, h, d, b))
+    return SPLIT_ROWS, out
 
 
 def _tenant_rows(col) -> dict:
@@ -275,16 +340,23 @@ class FlushLedger:
     sorted ((chain_id, rows), ...) pairs summing to the flush total —
     the ledger evidence that ONE flush coalesced rows from MANY
     chains (verifyplane/tenants.py; empty on shed-only cycles).
-    Written by the dispatcher even when tracing is off; read by
-    /dump_flushes, the scrape-time /metrics percentiles, and simnet
-    replay blobs."""
+    ``split`` is the tenant split RULE this flush's device-time
+    columns were charged under (SPLIT_EXACT = one tenant owned every
+    row, the charge is exact; SPLIT_ROWS = a fused multi-tenant batch,
+    charged row-proportionally with the integer residual on the last
+    tenant — see split_device_columns); the per-tenant accumulators
+    /dump_tenants serves are fed from exactly this rule, so the
+    conservation cross-check (tenants.reconcile_device) is an
+    identity, not an estimate. Written by the dispatcher even when
+    tracing is off; read by /dump_flushes, the scrape-time /metrics
+    percentiles, and simnet replay blobs."""
 
     FIELDS = ("seq", "ts_ms", "rows", "subs", "queued_ms", "pack_ms",
               "flight_ms", "collect_ms", "settle_ms", "airborne",
               "path", "stamp", "breaker", "staging_miss", "depth",
               "c_rows", "g_rows", "b_rows", "shed", "n_dev",
               "n_host", "dev0", "warm", "comp_ms", "h2d_ms",
-              "delta_bytes", "dev_ms", "util", "tenants")
+              "delta_bytes", "dev_ms", "util", "tenants", "split")
 
     __slots__ = ("_ring",)
 
@@ -873,6 +945,7 @@ class VerifyPlane:
                          if s.lane == LANE_CONSENSUS)
             g_rows = sum(len(s.rows) for s in settle
                          if s.lane == LANE_GATEWAY)
+            drain_tens = _tenant_split(settle)
             self.ledger.record([
                 drain_seq, round(t0 / 1e6, 3), len(rows),
                 len(settle), 0.0, 0.0, 0.0,
@@ -881,7 +954,8 @@ class VerifyPlane:
                 0, PATH_STOP_DRAIN, STAMP_HOST, self._breaker.state,
                 0, 0,
                 c_rows, g_rows, len(rows) - c_rows - g_rows, 0, 1,
-                1, 0, 0, 0.0, 0.0, 0, 0.0, 0.0, _tenant_split(settle),
+                1, 0, 0, 0.0, 0.0, 0, 0.0, 0.0, drain_tens,
+                SPLIT_EXACT if len(drain_tens) <= 1 else SPLIT_ROWS,
             ])
         for sub in fail:
             sub.future._fail(PlaneStopped(
@@ -1212,6 +1286,7 @@ class VerifyPlane:
                         STAMP_HOST,
                         self._breaker.state, 0, depth, 0, 0, 0,
                         len(shed), 0, 0, 0, 0, 0.0, 0.0, 0, 0.0, 0.0, (),
+                        SPLIT_EXACT,
                     ])
             if not batch:
                 # nothing to pack: land a flight (the first READY one,
@@ -1456,7 +1531,31 @@ class VerifyPlane:
                      - led[_L_TPACKED]) / 1e6, 3)
             led[_L_COLLECT] = round((t_settle - t_exec) / 1e6, 3)
             led[_L_SETTLE] = round((t_done - t_settle) / 1e6, 3)
+        self._charge_flush(led)
         self.ledger.record(led)
+
+    def _charge_flush(self, led) -> None:
+        """The cost observatory's per-flush hook, run once with every
+        column final (just before the record becomes a ring slot):
+        charge the flush's device-time columns to its tenants under
+        the recorded split rule, and feed the device ledger's cost
+        surfaces one observation. Always on — the whole hook stays
+        under the 10 us budget (bench.cost_hooks_bookkeeping_us,
+        asserted in tier-1), so there is no enable knob to forget."""
+        tens = led[_L_TEN]
+        if tens:
+            rule, shares = split_device_columns(
+                tens, led[_L_ROWS], led[_L_COMP], led[_L_H2D],
+                led[_L_DEV], led[_L_DBYTES])
+            led[_L_SPLIT] = rule
+            self.tenants.note_device_shares(shares)
+        # kernel cost surfaces: the on-device estimate when this flush
+        # flew, else the collect wall (the host/grouped verify runs
+        # inside the collect span — still the marginal cost of rows)
+        deviceledger.observe_flush(
+            led[_L_PATH], led[_L_STAMP], led[_L_ROWS], led[_L_NDEV],
+            led[_L_COMP], led[_L_H2D],
+            led[_L_DEV] if led[_L_DEV] else led[_L_COLLECT])
 
     def _observe_pack(self, seconds: float, h2d_bytes: int = 0,
                       stamp: str = STAMP_HOST) -> None:
@@ -1518,6 +1617,7 @@ class VerifyPlane:
                PATH_HOST, STAMP_HOST, self._breaker.state, 0, depth,
                c_rows, g_rows, rows - c_rows - g_rows, shed_n, 1, 1,
                0, 0, 0.0, 0.0, 0, 0.0, 0.0, tuple(sorted(tens.items())),
+               SPLIT_EXACT if len(tens) <= 1 else SPLIT_ROWS,
                t0, t0, gen, 0]
         for s in batch:
             # the join key consumers read AFTER the future resolves

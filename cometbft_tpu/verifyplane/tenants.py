@@ -53,7 +53,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional
 
 from cometbft_tpu.verifyplane.plane import (
-    DEFAULT_TENANT, LANES, PlaneOverloaded)
+    DEFAULT_TENANT, LANES, PlaneOverloaded, ms_to_us)
 
 # per-tenant submit-to-result samples kept for the wait percentiles
 TENANT_WAIT_WINDOW = 1024
@@ -91,7 +91,8 @@ class _Tenant:
 
     __slots__ = ("chain_id", "row_quota", "residency_budget",
                  "lane_rows", "lane_sheds", "warm_skips",
-                 "cold_evictions", "waits", "registered_ms")
+                 "cold_evictions", "waits", "registered_ms",
+                 "device_us", "comp_us", "h2d_us", "delta_bytes")
 
     def __init__(self, chain_id: str, row_quota: int = 0,
                  residency_budget: int = 0, registered_ms: float = 0.0):
@@ -106,6 +107,14 @@ class _Tenant:
         self.cold_evictions = 0
         self.waits: deque = deque(maxlen=TENANT_WAIT_WINDOW)
         self.registered_ms = registered_ms
+        # device-time chargeback (ISSUE 20): integer MICROseconds so
+        # the conservation cross-check (reconcile_device) is exact
+        # integer equality against the flush ledger — the ledger's ms
+        # columns are rounded to 3 decimals, so ms_to_us is lossless
+        self.device_us = 0
+        self.comp_us = 0
+        self.h2d_us = 0
+        self.delta_bytes = 0
 
     @property
     def rows_total(self) -> int:
@@ -134,7 +143,8 @@ class TenantRegistry:
         # the scrape's tenant="_retired" series accumulates these, so
         # sum(tenant_rows_total) never regresses across an eviction
         self.retired = {"rows": 0, "sheds": 0, "warm_skips": 0,
-                        "cold_evictions": 0}
+                        "cold_evictions": 0, "device_us": 0,
+                        "comp_us": 0, "h2d_us": 0, "delta_bytes": 0}
 
     # -- registration ------------------------------------------------------
 
@@ -181,6 +191,10 @@ class TenantRegistry:
             self.retired["sheds"] += t.sheds_total
             self.retired["warm_skips"] += t.warm_skips
             self.retired["cold_evictions"] += t.cold_evictions
+            self.retired["device_us"] += t.device_us
+            self.retired["comp_us"] += t.comp_us
+            self.retired["h2d_us"] += t.h2d_us
+            self.retired["delta_bytes"] += t.delta_bytes
             for key in [k for k, c in self._owners.items()
                         if c == chain_id]:
                 del self._owners[key]
@@ -233,6 +247,44 @@ class TenantRegistry:
     def note_warm_skip(self, chain_id: str) -> None:
         with self._lock:
             self._touch(chain_id).warm_skips += 1
+
+    def note_device(self, chain_id: str, comp_us: int, h2d_us: int,
+                    dev_us: int, delta_bytes: int) -> None:
+        """Charge one flush's (split) device-time share to a tenant,
+        with integer microseconds from split_device_columns, so the sum
+        over tenants equals the ledger record exactly (no float fold)."""
+        self.note_device_shares(
+            ((chain_id, comp_us, h2d_us, dev_us, delta_bytes),))
+
+    def note_device_shares(self, shares) -> None:
+        """Batched note_device over one flush's split shares — ONE lock
+        acquisition for the whole fused batch. This is the plane's
+        _charge_flush path, bound by the per-flush hook budget
+        (bench.cost_hooks_bookkeeping_us, tier-1-asserted < 10 us)."""
+        with self._lock:
+            for chain_id, comp_us, h2d_us, dev_us, delta_bytes in shares:
+                t = self._touch(chain_id)
+                t.comp_us += int(comp_us)
+                t.h2d_us += int(h2d_us)
+                t.device_us += int(dev_us)
+                t.delta_bytes += int(delta_bytes)
+
+    def device_totals(self) -> dict:
+        """Registry-wide device-time totals, live + retired, in the
+        accumulators' native integer microseconds. The conservation
+        invariant: these equal the flush ledger's column sums over the
+        same window (reconcile_device asserts it, cfg20 embeds it)."""
+        with self._lock:
+            tot = {"comp_us": self.retired["comp_us"],
+                   "h2d_us": self.retired["h2d_us"],
+                   "device_us": self.retired["device_us"],
+                   "delta_bytes": self.retired["delta_bytes"]}
+            for t in self._tenants.values():
+                tot["comp_us"] += t.comp_us
+                tot["h2d_us"] += t.h2d_us
+                tot["device_us"] += t.device_us
+                tot["delta_bytes"] += t.delta_bytes
+            return tot
 
     # -- residency ---------------------------------------------------------
 
@@ -342,6 +394,12 @@ class TenantRegistry:
                     "cold_evictions": t.cold_evictions,
                     "wait_ms": wait_summary_ms(t.waits),
                     "registered_ms": t.registered_ms,
+                    # device-time chargeback columns (ms rendered from
+                    # the exact integer-us accumulators)
+                    "device_ms": round(t.device_us / 1000.0, 3),
+                    "comp_ms": round(t.comp_us / 1000.0, 3),
+                    "h2d_ms": round(t.h2d_us / 1000.0, 3),
+                    "delta_bytes": t.delta_bytes,
                 }
             doc = {
                 "tenants": rows,
@@ -364,7 +422,9 @@ class TenantRegistry:
             ranked = sorted(self._tenants.values(),
                             key=lambda t: (-t.rows_total, t.chain_id))
             top = {t.chain_id: {"rows": t.rows_total,
-                                "sheds": t.sheds_total}
+                                "sheds": t.sheds_total,
+                                "device_ms": round(t.device_us / 1000.0,
+                                                   3)}
                    for t in ranked[:max(1, int(k))]}
             return {"top": top, "retired": dict(self.retired),
                     "registry_size": len(self._tenants)}
@@ -414,9 +474,36 @@ def dump_tenants() -> dict:
     if reg is None:
         return {"tenants": {}, "registry_size": 0, "evicted": 0,
                 "retired": {"rows": 0, "sheds": 0, "warm_skips": 0,
-                            "cold_evictions": 0},
+                            "cold_evictions": 0, "device_us": 0,
+                            "comp_us": 0, "h2d_us": 0,
+                            "delta_bytes": 0},
                 "owner_keys": 0}
     return reg.dump()
+
+
+def reconcile_device(records, registry: TenantRegistry) -> dict:
+    """Exact-accounting cross-check (the HBM reconcile() discipline,
+    applied to time): sum the flush ledger's device columns over
+    `records` (dicts from FlushLedger.records()) and compare against
+    the registry's live+retired per-tenant accumulators. While the
+    ledger ring still holds every charged flush (and no other plane
+    fed the registry), every drift is EXACTLY zero — integer us, no
+    tolerance band. cfg20 embeds this; a unit test drives it across
+    evict()/retirement."""
+    led = {"comp_us": 0, "h2d_us": 0, "device_us": 0, "delta_bytes": 0}
+    for r in records:
+        if not r.get("tenants"):
+            continue  # tenantless record: nothing was charged
+        led["comp_us"] += ms_to_us(r["comp_ms"])
+        led["h2d_us"] += ms_to_us(r["h2d_ms"])
+        led["device_us"] += ms_to_us(r["dev_ms"])
+        led["delta_bytes"] += int(r["delta_bytes"])
+    reg = registry.device_totals()
+    return {
+        "ledger": led,
+        "registry": reg,
+        "drift": {k: reg[k] - led[k] for k in led},
+    }
 
 
 def estimate_table_bytes(n_vals: int) -> int:

@@ -90,7 +90,9 @@ def test_eviction_folds_totals_into_retired_monotone():
     # scrape's tenant="_retired" series absorbs the departed totals
     assert total_after == total_before == 13
     assert after["retired"] == {"rows": 10, "sheds": 1,
-                                "warm_skips": 0, "cold_evictions": 0}
+                                "warm_skips": 0, "cold_evictions": 0,
+                                "device_us": 0, "comp_us": 0,
+                                "h2d_us": 0, "delta_bytes": 0}
     assert "a" not in after["top"] and after["registry_size"] == 1
 
 
@@ -101,6 +103,68 @@ def test_metrics_rows_top_k_by_cumulative_rows():
     mr = reg.metrics_rows(k=3)
     assert list(mr["top"]) == ["c11", "c10", "c09"]
     assert mr["registry_size"] == 12
+
+
+def test_device_attribution_conserves_across_eviction():
+    """ISSUE 20 conservation criterion, unit form: charge synthetic
+    flush records through split_device_columns -> note_device exactly
+    like the plane's _charge_flush, then prove reconcile_device drift
+    is zero BEFORE an eviction, AFTER evict() folds a tenant into
+    retired, and AFTER post-eviction charges — exact integer equality,
+    no tolerance band."""
+    from cometbft_tpu.verifyplane.plane import split_device_columns
+    from cometbft_tpu.verifyplane.tenants import reconcile_device
+
+    reg = TenantRegistry()
+    # flush records the way FlushLedger.records() renders them: ms
+    # columns rounded to 3 decimals (ms_to_us is lossless on these)
+    records = [
+        {"tenants": (("a", 7), ("b", 13)), "rows": 20,
+         "comp_ms": 12.345, "h2d_ms": 0.071, "dev_ms": 3.007,
+         "delta_bytes": 1234},
+        {"tenants": (("a", 100),), "rows": 100,
+         "comp_ms": 0.0, "h2d_ms": 0.25, "dev_ms": 1.5,
+         "delta_bytes": 4096},
+        {"tenants": (("a", 1), ("b", 1), ("c", 1)), "rows": 3,
+         "comp_ms": 0.001, "h2d_ms": 0.001, "dev_ms": 0.001,
+         "delta_bytes": 7},
+        # tenantless record (shed-only / drain shape): never charged
+        {"tenants": (), "rows": 0,
+         "comp_ms": 9.0, "h2d_ms": 9.0, "dev_ms": 9.0,
+         "delta_bytes": 999},
+    ]
+
+    def charge(rec):
+        rule, shares = split_device_columns(
+            rec["tenants"], rec["rows"], rec["comp_ms"],
+            rec["h2d_ms"], rec["dev_ms"], rec["delta_bytes"])
+        assert rule == ("exact" if len(rec["tenants"]) <= 1 else "rows")
+        for chain, comp_us, h2d_us, dev_us, dbytes in shares:
+            reg.note_device(chain, comp_us, h2d_us, dev_us, dbytes)
+
+    for rec in records[:2]:
+        charge(rec)
+    rd = reg and reconcile_device(records[:2], reg)
+    assert rd["drift"] == {"comp_us": 0, "h2d_us": 0,
+                           "device_us": 0, "delta_bytes": 0}, rd
+    # evict the heavy tenant: its totals fold into retired and the
+    # registry-wide sum (live + retired) still matches the ledger
+    assert reg.evict("a")
+    rd = reconcile_device(records[:2], reg)
+    assert rd["drift"] == {"comp_us": 0, "h2d_us": 0,
+                           "device_us": 0, "delta_bytes": 0}, rd
+    assert rd["registry"]["device_us"] > 0
+    # new flushes after the eviction (one re-registers "a") keep the
+    # identity; the tenantless record contributes to neither side
+    for rec in records[2:]:
+        charge(rec)
+    rd = reconcile_device(records, reg)
+    assert rd["drift"] == {"comp_us": 0, "h2d_us": 0,
+                           "device_us": 0, "delta_bytes": 0}, rd
+    # the dump renders the charged columns per live tenant
+    d = reg.dump()
+    assert d["tenants"]["b"]["device_ms"] > 0
+    assert d["retired"]["device_us"] > 0
 
 
 # -- plane integration: attribution, quotas, fair share ---------------------

@@ -138,7 +138,8 @@ def _coalesce_demo(plane, privs, chains):
         "f1": f1.result(5), "fc": fc.result(5),
     }
     recs = [{"rows": r["rows"], "c_rows": r["c_rows"],
-             "b_rows": r["b_rows"], "tenants": r["tenants"]}
+             "b_rows": r["b_rows"], "tenants": r["tenants"],
+             "split": r["split"]}
             for r in plane.ledger.records() if r["seq"] > mark_seq]
     return {"shed": shed, "verdicts": verdicts, "records": recs}
 
@@ -154,10 +155,16 @@ def _victim_commit_p99(sim, group):
 
 def _canon_registry(dump):
     """The registry dump's deterministic columns (wait quantiles ride
-    the real clock and are excluded)."""
+    the real clock and are excluded). The ISSUE-20 device-charge
+    columns ARE deterministic here — host-path flushes carry zero
+    comp/h2d/dev ms and zero delta bytes, and the split rule derives
+    from the tenant mix alone — so a replay must reproduce them
+    byte-identically too."""
     return {
         name: {k: t[k] for k in ("rows", "lane_rows", "lane_sheds",
-                                 "warm_skips", "cold_evictions")}
+                                 "warm_skips", "cold_evictions",
+                                 "device_ms", "comp_ms", "h2d_ms",
+                                 "delta_bytes")}
         for name, t in dump["tenants"].items()
     }
 
@@ -217,7 +224,8 @@ def _run_multichain(basedir, noisy: bool, seed: int = SEED):
         set_global_plane(None)
         plane.stop()
     led = [{"rows": r["rows"], "c_rows": r["c_rows"],
-            "b_rows": r["b_rows"], "tenants": r["tenants"]}
+            "b_rows": r["b_rows"], "tenants": r["tenants"],
+            "split": r["split"]}
            for r in plane.ledger.records()]
     return {
         "chains": chains, "hashes": hashes, "heights": heights,
@@ -299,6 +307,11 @@ def test_multichain_one_plane_coalesces(tenant_runs):
     split = dict(fused[0]["tenants"])
     assert split == {run["chains"][0]: 2, run["chains"][1]: 3}
     assert fused[0]["c_rows"] == 1 and fused[0]["b_rows"] == 4
+    # a cross-tenant fused flush records the row-proportional rule;
+    # single-tenant flushes record the exact sub-flush rule
+    assert fused[0]["split"] == "rows"
+    assert all(r["split"] == "exact" for r in demo["records"]
+               if len(r["tenants"]) <= 1)
     assert run["summary"]["coalesced_flushes"] >= 1
     # real keys, real signatures: everything verified True
     assert demo["verdicts"]["f0"] == (True, True)
@@ -341,7 +354,7 @@ def test_multichain_deterministic_replay(tenant_runs):
     assert [(r["seq"], r["code"], r["log"]) for r in a["flood_results"]] \
         == [(r["seq"], r["code"], r["log"]) for r in b["flood_results"]]
     cols = lambda led: [(r["rows"], r["c_rows"], r["b_rows"],  # noqa: E731
-                         r["tenants"]) for r in led]
+                         r["tenants"], r["split"]) for r in led]
     assert cols(a["ledger"]) == cols(b["ledger"])
     assert a["summary"]["tenants"] == b["summary"]["tenants"]
     assert _canon_registry(a["registry"]) == \

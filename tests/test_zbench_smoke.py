@@ -36,7 +36,7 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
     assert rc == 0
     by_metric = {ln["metric"]: ln for ln in lines}
     assert "smoke summary" in by_metric
-    assert by_metric["smoke summary"]["value"] == 13  # all configs ran
+    assert by_metric["smoke summary"]["value"] == 14  # all configs ran
     for ln in lines:
         assert set(ln) >= {"metric", "value", "unit", "vs_baseline"}
     # every smoke config produced a real number (no FAILED entries)
@@ -46,8 +46,8 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
                                "cfg14_smoke", "cfg15_smoke",
                                "cfg16_smoke", "cfg17_smoke",
                                "cfg18_smoke", "cfg19_smoke",
-                               "cfg2_smoke", "cfg4_smoke",
-                               "cfg6_smoke"]
+                               "cfg20_smoke", "cfg2_smoke",
+                               "cfg4_smoke", "cfg6_smoke"]
     assert all(r["value"] is not None for r in results.values())
     # the cfg6 miniature exercised the always-on flush ledger
     assert results["cfg6_smoke"]["extra"]["ledger"]["flushes"] >= 1
@@ -125,6 +125,17 @@ def test_bench_smoke_runs_host_only(tmp_path, capsys):
         ds["extra"]["staged_bytes_legacy"]
     assert ds["extra"]["ledger_stamp"]["device"] == 1
     assert ds["extra"]["ledger_stamp"]["host"] == 1
+    # the cfg20 miniature proved the cost observatory's arithmetic:
+    # the tenant split rule, integer-us charge conservation across
+    # eviction/retirement, the rows-bucket/percentile/marginal math,
+    # and the always-on per-flush hook under its 10 us budget
+    co = results["cfg20_smoke"]
+    assert all(co["extra"]["checks"].values()), co["extra"]["checks"]
+    assert 0 < co["value"] < 10.0  # us/flush, tier-1-asserted budget
+    assert co["extra"]["surfaces_sample"], co["extra"]
+    marg = [r["marginal_ms_per_row"]
+            for r in co["extra"]["surfaces_sample"]]
+    assert marg[0] is None and all(m is not None for m in marg[1:])
     # host-only contract: a smoke run must never pull in jax (tier-1
     # budget); only check when this process hadn't loaded it already
     if not jax_loaded_before:

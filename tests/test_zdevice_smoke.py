@@ -367,6 +367,83 @@ def test_flush_ledger_device_columns_host_path():
     assert dev["util"]["p50"] == 0.0
 
 
+def test_cross_dump_hammer_during_node_stop(fresh_ledger):
+    """ISSUE 20 satellite: dump readers racing the write side AND a
+    node teardown — one thread feeds the cost surfaces + compile ring
+    at full rate while readers hammer dump_devices() (the
+    /dump_devices body) across a live node's start/stop window. No
+    dump may raise or fail to serialize, every served cost_surfaces
+    row must be internally consistent (p50 <= p95, bounded samples),
+    and the final document accounts for every observation."""
+    import threading
+    import time
+
+    surf = deviceledger.CostSurfaces()
+    old_surf = deviceledger.install_surfaces(surf)
+    stop_evt = threading.Event()
+    errors = []
+    wrote = [0]
+
+    def writer():
+        i = 0
+        while not stop_evt.is_set():
+            stamp = "device" if i % 2 else "host"
+            deviceledger.observe_flush("hammer", stamp, 8 << (i % 4),
+                                       1, 0.01, 0.02, 0.5 + i % 7)
+            with deviceledger.attr_context("hammer.site", i):
+                deviceledger.record_compile(0.0001)
+            i += 1
+            wrote[0] = i
+            time.sleep(0.001)
+
+    def reader():
+        while not stop_evt.is_set():
+            try:
+                doc = deviceledger.dump_devices()
+                json.dumps(doc)
+                for row in doc["cost_surfaces"]:
+                    assert row["n"] >= 1
+                    assert row["dev_ms_p50"] <= row["dev_ms_p95"]
+                cm = deviceledger.cost_model()
+                cm.estimate_dev_ms("hammer", 64)
+            except Exception as e:  # noqa: BLE001 - the assertion
+                errors.append(repr(e))
+                return
+            time.sleep(0.002)  # 1-core host: leave the nodes air
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    nodes = _mini_net(2)
+    try:
+        for t in threads:
+            t.start()
+        for n in nodes:
+            n.start()
+        assert nodes[0].consensus.wait_for_height(1, timeout=30.0)
+        # the teardown races the readers — the satellite's point
+        for n in nodes:
+            n.stop()
+        time.sleep(0.05)  # post-stop dumps land under the hammer too
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for n in nodes:
+            n.stop()
+        deviceledger.install_surfaces(old_surf)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+    assert wrote[0] >= 4, "writer barely ran"
+    # the final document accounts for everything the writer observed
+    # (>= because live node flushes feed the same global surfaces)
+    final = surf.counters()
+    assert final["observed"] >= wrote[0], (final, wrote[0])
+    fams = {r["family"] for r in surf.surfaces()}
+    assert {"hammer", "hammer:stamped"} <= fams, fams
+    assert fresh_ledger.counters()["compiles"] >= wrote[0]
+
+
 def test_device_hook_budget():
     """ISSUE 15 acceptance: < 10 us per flush for the observatory's
     always-on hooks with tracing OFF (best of 3 to dodge 1-core

@@ -201,6 +201,83 @@ def test_tenant_report_diff_detects_synthetic_regression(
         tenant_report.load_tenants(str(junk))
 
 
+def test_cross_dump_hammer_during_plane_stop():
+    """ISSUE 20 satellite: reader threads hammering all three dump
+    surfaces (tenants + devices + flushes — the module-level bodies
+    the RPC handlers serve) WHILE the plane verifies fused
+    multi-tenant batches and then WHILE it stops. No dump may raise or
+    produce an unserializable document, and the post-stop history must
+    still reconcile EXACTLY: the registry's per-tenant device totals
+    equal the flush ledger's charged columns (integer us, drift all
+    zero) even though the readers raced the ledger drain."""
+    import threading
+    import time
+
+    from cometbft_tpu.libs import deviceledger
+
+    old_g, old_l = planemod._GLOBAL, planemod._LAST
+    old_rg, old_rl = vtenants._GLOBAL, vtenants._LAST
+    plane = VerifyPlane(window_ms=0.5, use_device=False)
+    plane.start()
+    stop_hammer = threading.Event()
+    served = {"tenants": 0, "devices": 0, "flushes": 0}
+    errors = []
+
+    def hammer(name, fn):
+        while not stop_hammer.is_set():
+            try:
+                json.dumps(fn())
+            except Exception as e:  # noqa: BLE001 - the assertion
+                errors.append((name, repr(e)))
+                return
+            served[name] += 1
+            time.sleep(0.002)  # 1-core host: don't starve the plane
+
+    threads = [
+        threading.Thread(target=hammer, args=pair, daemon=True)
+        for pair in (("tenants", vtenants.dump_tenants),
+                     ("devices", deviceledger.dump_devices),
+                     ("flushes", planemod.dump_flushes))]
+    try:
+        set_global_plane(plane)
+        for t in threads:
+            t.start()
+        # interleaved per-tenant work plus concurrent cross-tenant
+        # bursts, so the rows split rule runs under the hammer too
+        for i in range(6):
+            futs = [plane.submit_many(
+                        [(_Pub(), b"m", b"s")] * (2 + i % 3),
+                        chain_id=c)
+                    for c in ("hammer-a", "hammer-b")]
+            for f in futs:
+                assert all(f.result(30.0))
+        # stop WHILE the dump threads hammer: the exact seam this
+        # satellite targets — ledger drain + registry charge racing
+        # the read side
+        plane.stop()
+        time.sleep(0.05)  # a few post-stop dumps land under the test
+    finally:
+        stop_hammer.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        plane.stop()
+        set_global_plane(None)
+        planemod._GLOBAL, planemod._LAST = old_g, old_l
+        vtenants._GLOBAL, vtenants._LAST = old_rg, old_rl
+    assert not errors, errors
+    assert all(n >= 1 for n in served.values()), served
+    assert not any(t.is_alive() for t in threads)
+    # post-stop history: device columns present, charges conserved
+    recs = plane.ledger.records()
+    assert recs, "no flush recorded"
+    doc = plane.tenants.dump()
+    for col in ("device_ms", "comp_ms", "h2d_ms", "delta_bytes"):
+        assert col in doc["tenants"]["hammer-a"], doc["tenants"]
+    assert doc["tenants"]["hammer-a"]["rows"] >= 18  # 2+3+4 per pass
+    rd = vtenants.reconcile_device(recs, plane.tenants)
+    assert all(v == 0 for v in rd["drift"].values()), rd
+
+
 def test_no_jax_import():
     """The whole file ran host-only: nothing here may pull jax in."""
     if not _JAX_LOADED_BEFORE:

@@ -64,6 +64,12 @@ _TENANT_CFGS = ("cfg17",)
 # at the top level, so the sub-row falls back there.
 _CATCHUP_CFGS = ("cfg18",)
 
+# cfg20 embeds the cost observatory's figures: a "cfg20 cost" sub-row
+# tracks learned cost-surface cells and the largest bucket's marginal
+# ms-per-row (the capacity-planning slope device_report renders) —
+# '—' before its first recorded round, same as the other sub-rows
+_COST_CFGS = ("cfg20",)
+
 
 def _cfg_key(name: str):
     if name == "headline":
@@ -190,6 +196,23 @@ def history(rounds: dict) -> dict:
                     "vs_baseline": None,
                 })
             series[f"{cfg} replay"] = rpts
+        if cfg in _COST_CFGS:
+            spts = []
+            for tag in rounds:
+                extra = (rounds[tag].get(cfg) or {}).get("extra") or {}
+                cells = (extra.get("cost_counters") or {}).get("cells")
+                margs = [r.get("marginal_ms_per_row")
+                         for r in (extra.get("cost_surfaces") or [])
+                         if r.get("marginal_ms_per_row") is not None]
+                spts.append({
+                    "round": tag,
+                    "value": (f"{cells}c/{margs[-1]:g}ms"
+                              if cells is not None and margs
+                              else None),
+                    "unit": "cells/marginal-per-row",
+                    "vs_baseline": None,
+                })
+            series[f"{cfg} cost"] = spts
         if cfg in _COMMIT_LATENCY_CFGS:
             cpts = []
             for tag in rounds:

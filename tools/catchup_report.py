@@ -28,13 +28,15 @@ Usage:
 """
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from tools._report_common import (  # noqa: E402 - after sys.path fix
+    build_parser, flag_directed, run_cli)
 
 DEFAULT_THRESHOLD_PCT = 25.0
 DEFAULT_THRESHOLD_ABS = 4.0
@@ -105,13 +107,9 @@ def diff_report(rep_a: dict, rep_b: dict,
 
     def flag(a: float, b: float, bad_when: str,
              abs_floor: float = threshold_abs) -> str:
-        d = b - a
-        bad = d > 0 if bad_when == "up" else d < 0
-        if abs(d) < abs_floor:
-            return ""
-        if a > 0 and abs(d) / abs(a) * 100.0 < threshold_pct:
-            return ""
-        return "REGRESSED" if bad else "improved"
+        return flag_directed(a, b, bad_when=bad_when,
+                             threshold_pct=threshold_pct,
+                             abs_floor=abs_floor)
 
     def row(metric: str, bad_when: str,
             abs_floor: float = threshold_abs) -> dict:
@@ -202,46 +200,17 @@ def format_diff(diff: dict, path_a: str = "A",
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description="replay throughput report from a /dump_catchup "
-                    "document, or a replay-figure delta diff of two "
-                    "of them")
-    ap.add_argument("dumps", nargs="+",
-                    help="catch-up dump file(s); two with --diff")
-    ap.add_argument("--diff", action="store_true",
-                    help="diff two dumps: replay-figure delta table "
-                         "with regression flags")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of a table")
-    ap.add_argument("--threshold-pct", type=float,
-                    default=DEFAULT_THRESHOLD_PCT,
-                    help="relative regression floor (%%)")
-    ap.add_argument("--threshold-abs", type=float,
-                    default=DEFAULT_THRESHOLD_ABS,
-                    help="absolute regression floor (count / value)")
-    ap.add_argument("--fail-on-regression", action="store_true",
-                    help="exit 1 when the diff flags any regression")
-    args = ap.parse_args(argv)
-    if args.fail_on_regression and not args.diff:
-        # only a diff can flag regressions; a gate wired without --diff
-        # would be permanently green
-        ap.error("--fail-on-regression requires --diff")
-    if args.diff:
-        if len(args.dumps) != 2:
-            ap.error("--diff needs exactly two dump files")
-        rep_a = catchup_report(load_catchup(args.dumps[0]))
-        rep_b = catchup_report(load_catchup(args.dumps[1]))
-        diff = diff_report(rep_a, rep_b, args.threshold_pct,
-                           args.threshold_abs)
-        print(json.dumps(diff) if args.json
-              else format_diff(diff, args.dumps[0], args.dumps[1]))
-        return 1 if args.fail_on_regression and diff["regressions"] \
-            else 0
-    if len(args.dumps) != 1:
-        ap.error("exactly one dump file (or use --diff A B)")
-    rep = catchup_report(load_catchup(args.dumps[0]))
-    print(json.dumps(rep) if args.json else format_report(rep))
-    return 0
+    ap = build_parser(
+        "replay throughput report from a /dump_catchup document, or "
+        "a replay-figure delta diff of two of them",
+        operand_help="catch-up dump file(s); two with --diff",
+        diff_help="diff two dumps: replay-figure delta table with "
+                  "regression flags",
+        default_pct=DEFAULT_THRESHOLD_PCT,
+        default_abs=DEFAULT_THRESHOLD_ABS)
+    return run_cli(argv, parser=ap, load=load_catchup,
+                   report=catchup_report, diff=diff_report,
+                   fmt_report=format_report, fmt_diff=format_diff)
 
 
 if __name__ == "__main__":

@@ -27,7 +27,6 @@ Usage:
 """
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
@@ -35,6 +34,9 @@ from typing import List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from tools._report_common import (  # noqa: E402 - after sys.path fix
+    build_parser, flag_directional, run_cli)
 
 DEFAULT_THRESHOLD_PCT = 25.0
 DEFAULT_THRESHOLD_ABS = 8.0
@@ -106,6 +108,10 @@ def device_report(dump: dict) -> dict:
         "dev_ms_p50": (fl.get("dev_ms") or {}).get("p50", 0.0),
         "flush_comp_ms": fl.get("comp_ms", 0.0),
         "reconcile": dump.get("reconcile", {}),
+        # ISSUE 20 kernel cost surfaces (absent on dumps from builds
+        # predating the recorder)
+        "cost_surfaces": list(dump.get("cost_surfaces") or []),
+        "cost_counters": dict(dump.get("cost_counters") or {}),
     }
 
 
@@ -125,17 +131,11 @@ def diff_report(rep_a: dict, rep_b: dict,
     def flag_of(a: float, b: float, bad_dir: int = +1,
                 abs_floor: float = threshold_abs,
                 any_growth: bool = False) -> str:
-        d = (b - a) * bad_dir
-        if d <= 0:
-            return "improved" if d < 0 and abs(d) >= abs_floor else ""
-        if d < abs_floor:
-            return ""
         # any_growth: the relative threshold is waived — one more
         # steady recompile flags no matter how big the baseline is
-        if not any_growth and a > 0 \
-                and d / abs(a) * 100.0 < threshold_pct:
-            return ""
-        return "REGRESSED"
+        return flag_directional(a, b, threshold_pct=threshold_pct,
+                                abs_floor=abs_floor, bad_dir=bad_dir,
+                                any_growth=any_growth)
 
     rows = [
         {"metric": "compiles", "a": rep_a["compiles"],
@@ -174,6 +174,28 @@ def diff_report(rep_a: dict, rep_b: dict,
                      "delta": round(ub - ua, 4),
                      "flag": flag_of(ua, ub, bad_dir=-1,
                                      abs_floor=0.05)})
+    # kernel cost surfaces: a cell whose marginal ms-per-row grew past
+    # both thresholds is a MARGINAL-COST REGRESSION — the same jit
+    # family at the same shape charging more per row than it used to
+    cs_a = {(r["family"], r["rows_bucket"], r["n_dev"]): r
+            for r in rep_a["cost_surfaces"]}
+    for r in rep_b["cost_surfaces"]:
+        key = (r["family"], r["rows_bucket"], r["n_dev"])
+        before = cs_a.get(key)
+        if before is None:
+            continue
+        ma = before.get("marginal_ms_per_row")
+        mb = r.get("marginal_ms_per_row")
+        if ma is None or mb is None:
+            continue
+        fl = flag_of(ma, mb, abs_floor=0.001)
+        if fl:
+            fam, bucket, n_dev = key
+            rows.append({
+                "metric": f"marginal_ms_per_row"
+                          f"[{fam}@{bucket}x{n_dev}]",
+                "a": ma, "b": mb, "delta": round(mb - ma, 6),
+                "flag": fl})
 
     notes = []
     sites_b = {r["site"]: r for r in rep_b["sites"]}
@@ -234,6 +256,20 @@ def format_report(rep: dict) -> str:
             f"flush device split: util p50 {rep['util_p50']}, dev_ms "
             f"p50 {rep['dev_ms_p50']}, compile ms charged to flushes "
             f"{rep['flush_comp_ms']}")
+    if rep["cost_surfaces"]:
+        cc = rep["cost_counters"]
+        lines += ["", f"cost surfaces ({cc.get('observed', 0)} flush "
+                      f"observations, {cc.get('cells', 0)} cells):",
+                  f"{'family':<22}{'rows<=':>8}{'ndev':>5}{'n':>5}"
+                  f"{'dev p50':>9}{'dev p95':>9}{'h2d p50':>9}"
+                  f"{'ms/row':>10}"]
+        for r in rep["cost_surfaces"]:
+            marg = r.get("marginal_ms_per_row")
+            lines.append(
+                f"{r['family']:<22}{r['rows_bucket']:>8}"
+                f"{r['n_dev']:>5}{r['n']:>5}{r['dev_ms_p50']:>9}"
+                f"{r['dev_ms_p95']:>9}{r['h2d_ms_p50']:>9}"
+                f"{marg if marg is not None else '-':>10}")
     rc = rep["reconcile"]
     if rc:
         drift = rc.get("table_drift", 0)
@@ -263,46 +299,18 @@ def format_diff(diff: dict, path_a: str = "A", path_b: str = "B") -> str:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description="compile/residency/utilization tables from a "
-                    "/dump_devices document, or a device-figure delta "
-                    "diff of two of them")
-    ap.add_argument("dumps", nargs="+",
-                    help="device dump file(s); two files with --diff")
-    ap.add_argument("--diff", action="store_true",
-                    help="diff two dumps: device-figure delta table "
-                         "with regression flags")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of a table")
-    ap.add_argument("--threshold-pct", type=float,
-                    default=DEFAULT_THRESHOLD_PCT,
-                    help="relative regression floor (%%)")
-    ap.add_argument("--threshold-abs", type=float,
-                    default=DEFAULT_THRESHOLD_ABS,
-                    help="absolute regression floor (count / bytes)")
-    ap.add_argument("--fail-on-regression", action="store_true",
-                    help="exit 1 when the diff flags any regression")
-    args = ap.parse_args(argv)
-    if args.fail_on_regression and not args.diff:
-        # only a diff can flag regressions; a gate wired without --diff
-        # would be permanently green
-        ap.error("--fail-on-regression requires --diff")
-    if args.diff:
-        if len(args.dumps) != 2:
-            ap.error("--diff needs exactly two dump files")
-        rep_a = device_report(load_devices(args.dumps[0]))
-        rep_b = device_report(load_devices(args.dumps[1]))
-        diff = diff_report(rep_a, rep_b, args.threshold_pct,
-                           args.threshold_abs)
-        print(json.dumps(diff) if args.json
-              else format_diff(diff, args.dumps[0], args.dumps[1]))
-        return 1 if args.fail_on_regression and diff["regressions"] \
-            else 0
-    if len(args.dumps) != 1:
-        ap.error("exactly one dump file (or use --diff A B)")
-    rep = device_report(load_devices(args.dumps[0]))
-    print(json.dumps(rep) if args.json else format_report(rep))
-    return 0
+    ap = build_parser(
+        "compile/residency/utilization tables from a /dump_devices "
+        "document, or a device-figure delta diff of two of them",
+        operand_help="device dump file(s); two files with --diff",
+        diff_help="diff two dumps: device-figure delta table with "
+                  "regression flags",
+        default_pct=DEFAULT_THRESHOLD_PCT,
+        default_abs=DEFAULT_THRESHOLD_ABS,
+        abs_help="absolute regression floor (count / bytes)")
+    return run_cli(argv, parser=ap, load=load_devices,
+                   report=device_report, diff=diff_report,
+                   fmt_report=format_report, fmt_diff=format_diff)
 
 
 if __name__ == "__main__":

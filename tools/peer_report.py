@@ -25,7 +25,6 @@ Usage:
 """
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
@@ -33,6 +32,9 @@ from typing import List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from tools._report_common import (  # noqa: E402 - after sys.path fix
+    build_parser, flag_symmetric, run_cli)
 
 # aggregate health counters the diff flags on: bigger = sicker
 HEALTH_KEYS = ("blocked_puts", "full_drops", "throttle_stalls",
@@ -127,12 +129,8 @@ def diff_report(rep_a: dict, rep_b: dict,
     guards noise on tiny ones); RTT p50 diffs as its own row."""
 
     def flag_of(a: float, b: float) -> str:
-        d = b - a
-        if abs(d) < threshold_abs:
-            return ""
-        if a > 0 and abs(d) / a * 100.0 < threshold_pct:
-            return ""
-        return "REGRESSED" if d > 0 else "improved"
+        return flag_symmetric(a, b, threshold_pct=threshold_pct,
+                              abs_floor=threshold_abs)
 
     rows = []
     for key in HEALTH_KEYS:
@@ -230,45 +228,18 @@ def format_diff(diff: dict, path_a: str = "A", path_b: str = "B") -> str:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description="per-peer traffic/health table from a /dump_peers "
-                    "document, or a health delta diff of two of them")
-    ap.add_argument("dumps", nargs="+",
-                    help="peer dump file(s); two files with --diff")
-    ap.add_argument("--diff", action="store_true",
-                    help="diff two dumps: health-counter delta table "
-                         "with regression flags")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of a table")
-    ap.add_argument("--threshold-pct", type=float,
-                    default=DEFAULT_THRESHOLD_PCT,
-                    help="relative regression floor (%%)")
-    ap.add_argument("--threshold-abs", type=float,
-                    default=DEFAULT_THRESHOLD_ABS,
-                    help="absolute regression floor (count / ms)")
-    ap.add_argument("--fail-on-regression", action="store_true",
-                    help="exit 1 when the diff flags any regression")
-    args = ap.parse_args(argv)
-    if args.fail_on_regression and not args.diff:
-        # only a diff can flag regressions; a gate wired without --diff
-        # would be permanently green
-        ap.error("--fail-on-regression requires --diff")
-    if args.diff:
-        if len(args.dumps) != 2:
-            ap.error("--diff needs exactly two dump files")
-        rep_a = peer_report(load_peers(args.dumps[0]))
-        rep_b = peer_report(load_peers(args.dumps[1]))
-        diff = diff_report(rep_a, rep_b, args.threshold_pct,
-                           args.threshold_abs)
-        print(json.dumps(diff) if args.json
-              else format_diff(diff, args.dumps[0], args.dumps[1]))
-        return 1 if args.fail_on_regression and diff["regressions"] \
-            else 0
-    if len(args.dumps) != 1:
-        ap.error("exactly one dump file (or use --diff A B)")
-    rep = peer_report(load_peers(args.dumps[0]))
-    print(json.dumps(rep) if args.json else format_report(rep))
-    return 0
+    ap = build_parser(
+        "per-peer traffic/health table from a /dump_peers document, "
+        "or a health delta diff of two of them",
+        operand_help="peer dump file(s); two files with --diff",
+        diff_help="diff two dumps: health-counter delta table with "
+                  "regression flags",
+        default_pct=DEFAULT_THRESHOLD_PCT,
+        default_abs=DEFAULT_THRESHOLD_ABS,
+        abs_help="absolute regression floor (count / ms)")
+    return run_cli(argv, parser=ap, load=load_peers,
+                   report=peer_report, diff=diff_report,
+                   fmt_report=format_report, fmt_diff=format_diff)
 
 
 if __name__ == "__main__":

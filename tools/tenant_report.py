@@ -28,13 +28,15 @@ Usage:
 """
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from tools._report_common import (  # noqa: E402 - after sys.path fix
+    build_parser, flag_directional, run_cli)
 
 DEFAULT_THRESHOLD_PCT = 25.0
 DEFAULT_THRESHOLD_ABS = 4.0
@@ -81,6 +83,12 @@ def tenant_report(dump: dict) -> dict:
             "resident_tables": res.get("tables", 0),
             "wait_p99_ms": wait.get("p99_ms", 0.0),
             "wait_n": wait.get("n", 0),
+            # ISSUE 20 device chargeback columns (0.0 on dumps from
+            # builds predating the split — the report stays readable)
+            "device_ms": t.get("device_ms", 0.0),
+            "comp_ms": t.get("comp_ms", 0.0),
+            "h2d_ms": t.get("h2d_ms", 0.0),
+            "delta_bytes": t.get("delta_bytes", 0),
         })
     tenants.sort(key=lambda r: (-r["rows"], r["tenant"]))
     retired = dict(dump.get("retired", {}))
@@ -103,6 +111,12 @@ def tenant_report(dump: dict) -> dict:
                                     for r in tenants),
         "wait_p99_worst_ms": max(
             (r["wait_p99_ms"] for r in tenants), default=0.0),
+        "device_ms_total": round(
+            sum(r["device_ms"] for r in tenants)
+            + retired.get("device_us", 0) / 1000.0, 3),
+        "comp_ms_total": round(
+            sum(r["comp_ms"] for r in tenants)
+            + retired.get("comp_us", 0) / 1000.0, 3),
     }
 
 
@@ -120,14 +134,8 @@ def diff_report(rep_a: dict, rep_b: dict,
 
     def flag_of(a: float, b: float,
                 abs_floor: float = threshold_abs) -> str:
-        d = b - a
-        if d <= 0:
-            return "improved" if d < 0 and abs(d) >= abs_floor else ""
-        if d < abs_floor:
-            return ""
-        if a > 0 and d / abs(a) * 100.0 < threshold_pct:
-            return ""
-        return "REGRESSED"
+        return flag_directional(a, b, threshold_pct=threshold_pct,
+                                abs_floor=abs_floor)
 
     def row(metric: str, abs_floor: float = threshold_abs) -> dict:
         a, b = rep_a[metric], rep_b[metric]
@@ -140,6 +148,15 @@ def diff_report(rep_a: dict, rep_b: dict,
         row("warm_skips_total"),
         row("cold_evictions_total"),
         row("wait_p99_worst_ms", abs_floor=max(threshold_abs, 10.0)),
+        # compile ms charged to tenants growing means the pod started
+        # paying recompiles for someone — a regression signal
+        row("comp_ms_total", abs_floor=max(threshold_abs, 10.0)),
+        # total device ms is workload-following, informational only
+        {"metric": "device_ms_total", "a": rep_a["device_ms_total"],
+         "b": rep_b["device_ms_total"],
+         "delta": round(rep_b["device_ms_total"]
+                        - rep_a["device_ms_total"], 4),
+         "flag": ""},
         {"metric": "rows_total", "a": rep_a["rows_total"],
          "b": rep_b["rows_total"],
          "delta": round(rep_b["rows_total"] - rep_a["rows_total"], 4),
@@ -152,6 +169,24 @@ def diff_report(rep_a: dict, rep_b: dict,
 
     notes = []
     by_a = {r["tenant"]: r for r in rep_a["tenants"]}
+    # device-share growth: a tenant taking a materially bigger slice
+    # of the pod's device time than before (>= 10 percentage points
+    # on a non-trivial total) is the noisy-neighbor chargeback signal
+    tot_a = max(rep_a["device_ms_total"], 1e-9)
+    tot_b = max(rep_b["device_ms_total"], 1e-9)
+    if rep_b["device_ms_total"] >= 1.0:
+        for r in rep_b["tenants"]:
+            share_b = r["device_ms"] / tot_b
+            before = by_a.get(r["tenant"])
+            share_a = (before["device_ms"] / tot_a) if before else 0.0
+            if share_b - share_a >= 0.10:
+                notes.append(
+                    f"tenant {r['tenant']!r} device-share growth: "
+                    f"{share_a * 100.0:.1f}% -> {share_b * 100.0:.1f}% "
+                    f"of pod device time ({r['device_ms']} ms) — pull "
+                    f"/dump_devices cost_surfaces for its flush "
+                    f"family and /dump_flushes for WHO queued the "
+                    f"rows")
     for r in rep_b["tenants"]:
         before = by_a.get(r["tenant"])
         if before is None:
@@ -194,14 +229,19 @@ def format_report(rep: dict) -> str:
     if rep["tenants"]:
         lines += ["", f"{'tenant':<22}{'rows':>10}{'sheds':>7}"
                       f"{'wskip':>7}{'cevict':>7}{'resKB':>8}"
-                      f"{'tables':>7}{'p99ms':>9}{'quota':>7}"]
+                      f"{'tables':>7}{'p99ms':>9}{'quota':>7}"
+                      f"{'dev_ms':>10}{'comp_ms':>9}"]
         for r in rep["tenants"]:
             lines.append(
                 f"{r['tenant']:<22}{r['rows']:>10}{r['sheds']:>7}"
                 f"{r['warm_skips']:>7}{r['cold_evictions']:>7}"
                 f"{r['resident_bytes'] // 1024:>8}"
                 f"{r['resident_tables']:>7}{r['wait_p99_ms']:>9}"
-                f"{r['row_quota'] or '-':>7}")
+                f"{r['row_quota'] or '-':>7}"
+                f"{r['device_ms']:>10}{r['comp_ms']:>9}")
+        lines.append(
+            f"device time charged: {rep['device_ms_total']} ms "
+            f"(compile {rep['comp_ms_total']} ms), retired included")
     return "\n".join(lines)
 
 
@@ -221,46 +261,17 @@ def format_diff(diff: dict, path_a: str = "A",
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description="per-tenant occupancy and QoS tables from a "
-                    "/dump_tenants document, or a pod-figure delta "
-                    "diff of two of them")
-    ap.add_argument("dumps", nargs="+",
-                    help="tenant dump file(s); two with --diff")
-    ap.add_argument("--diff", action="store_true",
-                    help="diff two dumps: pod-figure delta table "
-                         "with regression flags")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of a table")
-    ap.add_argument("--threshold-pct", type=float,
-                    default=DEFAULT_THRESHOLD_PCT,
-                    help="relative regression floor (%%)")
-    ap.add_argument("--threshold-abs", type=float,
-                    default=DEFAULT_THRESHOLD_ABS,
-                    help="absolute regression floor (count / value)")
-    ap.add_argument("--fail-on-regression", action="store_true",
-                    help="exit 1 when the diff flags any regression")
-    args = ap.parse_args(argv)
-    if args.fail_on_regression and not args.diff:
-        # only a diff can flag regressions; a gate wired without --diff
-        # would be permanently green
-        ap.error("--fail-on-regression requires --diff")
-    if args.diff:
-        if len(args.dumps) != 2:
-            ap.error("--diff needs exactly two dump files")
-        rep_a = tenant_report(load_tenants(args.dumps[0]))
-        rep_b = tenant_report(load_tenants(args.dumps[1]))
-        diff = diff_report(rep_a, rep_b, args.threshold_pct,
-                           args.threshold_abs)
-        print(json.dumps(diff) if args.json
-              else format_diff(diff, args.dumps[0], args.dumps[1]))
-        return 1 if args.fail_on_regression and diff["regressions"] \
-            else 0
-    if len(args.dumps) != 1:
-        ap.error("exactly one dump file (or use --diff A B)")
-    rep = tenant_report(load_tenants(args.dumps[0]))
-    print(json.dumps(rep) if args.json else format_report(rep))
-    return 0
+    ap = build_parser(
+        "per-tenant occupancy and QoS tables from a /dump_tenants "
+        "document, or a pod-figure delta diff of two of them",
+        operand_help="tenant dump file(s); two with --diff",
+        diff_help="diff two dumps: pod-figure delta table with "
+                  "regression flags",
+        default_pct=DEFAULT_THRESHOLD_PCT,
+        default_abs=DEFAULT_THRESHOLD_ABS)
+    return run_cli(argv, parser=ap, load=load_tenants,
+                   report=tenant_report, diff=diff_report,
+                   fmt_report=format_report, fmt_diff=format_diff)
 
 
 if __name__ == "__main__":

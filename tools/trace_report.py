@@ -26,9 +26,16 @@ Usage:
 """
 from __future__ import annotations
 
-import argparse
 import json
+import os
+import sys
 from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools._report_common import (  # noqa: E402 - after sys.path fix
+    build_parser, flag_symmetric, run_cli)
 
 # verify-plane flush pipeline, in submission order: the critical-path
 # section reports these stages first and computes pack/flight overlap
@@ -261,12 +268,8 @@ def diff_report(rep_a: dict, rep_b: dict,
               if s not in a_by]
 
     def flag_of(ma: float, mb: float) -> str:
-        d = mb - ma
-        if abs(d) < threshold_ms:
-            return ""
-        if ma > 0 and abs(d) / ma * 100.0 < threshold_pct:
-            return ""
-        return "REGRESSED" if d > 0 else "improved"
+        return flag_symmetric(ma, mb, threshold_pct=threshold_pct,
+                              abs_floor=threshold_ms)
 
     rows = []
     for name in order:
@@ -425,46 +428,24 @@ def format_diff(diff: dict, path_a: str = "A", path_b: str = "B") -> str:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        description="per-stage critical-path table from a Chrome trace, "
-                    "or a stage-delta diff of two traces")
-    ap.add_argument("traces", nargs="+",
-                    help="trace file(s) (libs/tracing export); two "
-                         "files with --diff")
-    ap.add_argument("--diff", action="store_true",
-                    help="diff two traces: stage-delta + overlap-delta "
-                         "tables with regression flags")
-    ap.add_argument("--json", action="store_true",
-                    help="emit the report as JSON instead of a table")
-    ap.add_argument("--threshold-pct", type=float,
-                    default=DEFAULT_THRESHOLD_PCT,
-                    help="relative regression floor (mean ms, %%)")
-    ap.add_argument("--threshold-ms", type=float,
-                    default=DEFAULT_THRESHOLD_MS,
-                    help="absolute regression floor (mean ms)")
-    ap.add_argument("--fail-on-regression", action="store_true",
-                    help="exit 1 when the diff flags any regression")
-    args = ap.parse_args(argv)
-    if args.fail_on_regression and not args.diff:
-        # only a diff can flag regressions; a gate wired without --diff
-        # would be permanently green
-        ap.error("--fail-on-regression requires --diff")
-    if args.diff:
-        if len(args.traces) != 2:
-            ap.error("--diff needs exactly two trace files")
-        rep_a = stage_report(load(args.traces[0]))
-        rep_b = stage_report(load(args.traces[1]))
-        diff = diff_report(rep_a, rep_b, args.threshold_pct,
-                           args.threshold_ms)
-        print(json.dumps(diff) if args.json
-              else format_diff(diff, args.traces[0], args.traces[1]))
-        return 1 if args.fail_on_regression and diff["regressions"] \
-            else 0
-    if len(args.traces) != 1:
-        ap.error("exactly one trace file (or use --diff A B)")
-    rep = stage_report(load(args.traces[0]))
-    print(json.dumps(rep) if args.json else format_report(rep))
-    return 0
+    ap = build_parser(
+        "per-stage critical-path table from a Chrome trace, or a "
+        "stage-delta diff of two traces",
+        operand="traces",
+        operand_help="trace file(s) (libs/tracing export); two files "
+                     "with --diff",
+        diff_help="diff two traces: stage-delta + overlap-delta "
+                  "tables with regression flags",
+        default_pct=DEFAULT_THRESHOLD_PCT,
+        default_abs=DEFAULT_THRESHOLD_MS,
+        pct_help="relative regression floor (mean ms, %%)",
+        abs_flag="--threshold-ms",
+        abs_help="absolute regression floor (mean ms)")
+    return run_cli(argv, parser=ap, load=load, report=stage_report,
+                   diff=diff_report, fmt_report=format_report,
+                   fmt_diff=format_diff, operand="traces",
+                   noun="trace")
+
 
 
 if __name__ == "__main__":
